@@ -1,0 +1,29 @@
+"""machine_learning_replications_tpu — a TPU-native clinical-ML ensemble framework.
+
+A ground-up JAX / XLA / Pallas re-design of the capabilities of the reference
+repository ``PaulTFLi/Machine-Learning-Replications`` (the heart-failure
+progression replication package, ``HF/train_ensemble_public.py`` /
+``HF/predict_hf.py``): MAT-file ingestion, 1-NN imputation, LassoCV feature
+selection, a stacking ensemble (StandardScaler→RBF-SVC, gradient-boosted
+stumps, L1 logistic regression, logistic meta-learner), metrics/reporting,
+and model persistence — all running on a TPU device mesh.
+
+Nothing here is a port: the compute path is functional JAX (``jit`` /
+``vmap`` / ``lax.scan`` / ``shard_map``), hot histogram work is a Pallas
+kernel, host-side ingest is native C++ where the reference leaned on
+scipy/sklearn's C internals, and persistence is Orbax pytree checkpoints.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+  L6  cli                      — train / predict / sweep entry points
+  L5  eval (ops.metrics)       — device-side AUC / PR / report + Wald CI bands
+  L4  models.stacking          — the ensemble graph, fit + predict_proba
+  L3  models.feature_selection — LassoCV + top-k selection; models.knn_impute
+  L2  data                     — .mat / synthetic ingest → sharded DeviceArrays
+  L1  persist                  — Orbax pytrees + legacy-pickle import oracle
+  L0  ops / native             — Pallas kernels, XLA collectives, C++ runtime
+"""
+
+__version__ = "0.1.0"
+
+from machine_learning_replications_tpu import config as config  # noqa: F401

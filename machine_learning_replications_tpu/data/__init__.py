@@ -1,0 +1,30 @@
+"""L2 — data ingestion.
+
+Host-side ingest (``.mat`` files, synthetic cohorts) producing arrays that are
+then placed onto the TPU mesh as sharded DeviceArrays (see ``sharding.py``).
+Reference contract: ``HF/load_data_public.py:4-14``.
+"""
+
+from machine_learning_replications_tpu.data.matloader import load_data, save_data
+from machine_learning_replications_tpu.data.schema import (
+    COHORT_SCHEMA,
+    N_COHORT,
+    SELECTED_17,
+    selected_indices,
+    variable_names,
+)
+from machine_learning_replications_tpu.data.synthetic import make_cohort
+from machine_learning_replications_tpu.data.sharding import shard_rows, pad_rows
+
+__all__ = [
+    "load_data",
+    "save_data",
+    "make_cohort",
+    "shard_rows",
+    "pad_rows",
+    "COHORT_SCHEMA",
+    "N_COHORT",
+    "SELECTED_17",
+    "selected_indices",
+    "variable_names",
+]

@@ -1,0 +1,60 @@
+"""MAT-file ingestion (reference contract: ``HF/load_data_public.py:4-14``).
+
+The ``.mat`` must contain ``data_tb`` (features + outcome in the last column)
+and ``clin_var_names``; the loader returns float64 ``X[n, d]``, ``y[n]`` and
+the names row. Two backends:
+
+  * native  — the in-repo C++ MAT-v5 reader (``native/``, via ctypes), the
+    TPU-build equivalent of scipy's C parser the reference leaned on;
+  * scipy   — fallback, identical semantics.
+
+Both are host-side by design; device placement happens in ``sharding.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_data(
+    dataset_path: str, backend: str = "auto"
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Load ``(X, Y, var_names)`` from a MAT file.
+
+    Mirrors ``load_data_public.load_data``: features are all columns but the
+    last of ``data_tb``; outcome is the last column; both cast to float64.
+    """
+    data, var_names = _read_mat(dataset_path, backend)
+    X = data[:, :-1].astype(np.float64)
+    Y = data[:, -1].astype(np.float64)
+    return X, Y, var_names
+
+
+def _read_mat(path: str, backend: str) -> tuple[np.ndarray, np.ndarray]:
+    if backend in ("auto", "native"):
+        try:
+            from machine_learning_replications_tpu.native import matio
+
+            out = matio.read_mat_vars(path, ["data_tb", "clin_var_names"])
+            if out is None:
+                raise RuntimeError("native matio backend unavailable")
+            return out["data_tb"], out["clin_var_names"]
+        except Exception:
+            # 'auto' falls through to scipy on *any* native failure (missing
+            # build, unsupported .mat variant); 'native' surfaces the error.
+            if backend == "native":
+                raise
+    import scipy.io as sio
+
+    d = sio.loadmat(path)
+    return d["data_tb"], d["clin_var_names"]
+
+
+def save_data(
+    dataset_path: str, X: np.ndarray, y: np.ndarray, var_names: np.ndarray
+) -> None:
+    """Write a cohort in the reference's ``.mat`` layout (for round-trips/tests)."""
+    import scipy.io as sio
+
+    data_tb = np.concatenate([X, y.reshape(-1, 1)], axis=1)
+    sio.savemat(dataset_path, {"data_tb": data_tb, "clin_var_names": var_names})

@@ -1,0 +1,48 @@
+"""Device placement — host arrays → sharded DeviceArrays on the mesh.
+
+BASELINE.json's north star: the loader "emits sharded DeviceArrays across the
+TPU mesh". The reference has no device concept at all (pure single-process
+numpy); here the cohort's row dimension is the data-parallel axis
+(SURVEY.md §2.5), laid out with ``NamedSharding(mesh, P('data', None))`` so
+per-shard histogram partials ride ICI via ``psum``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
+    """Pad axis 0 up to a multiple (XLA wants static, divisible shard shapes).
+
+    Returns the padded array and the original row count. Padding rows are
+    zeros; training/metric code masks them out via the returned count — a
+    masked reduction, not a semantic change (SURVEY.md §7 "fold-size padding
+    with masked reductions").
+    """
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_width), n
+
+
+def shard_rows(
+    mesh: Mesh, *arrays: np.ndarray, axis: str = "data"
+) -> tuple[jax.Array, ...] | jax.Array:
+    """Place arrays on ``mesh`` with rows sharded over ``axis``.
+
+    Each array is padded so its row count divides the axis size; callers that
+    need the true row count should use ``pad_rows`` explicitly first.
+    """
+    n_shards = mesh.shape[axis]
+    out = []
+    for a in arrays:
+        padded, _ = pad_rows(np.asarray(a), n_shards)
+        spec = P(axis, *([None] * (padded.ndim - 1)))
+        out.append(jax.device_put(padded, NamedSharding(mesh, spec)))
+    return out[0] if len(out) == 1 else tuple(out)

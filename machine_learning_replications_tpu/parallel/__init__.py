@@ -1,0 +1,23 @@
+"""Parallelism & communication (SURVEY.md §2.5 — all ABSENT in the reference).
+
+The reference is single-process/single-threaded; its implicit parallel axes
+(cohort rows, boosting-stage histogram work, feature tiles in split search,
+CV folds, ensemble members) are promoted here to first-class mesh axes:
+
+  data  — rows sharded across chips; histogram/metric partials psum over ICI
+  model — feature/bin tiles of the split search; fold/member fan-out
+
+Communication is whatever XLA emits for the collectives (`psum`,
+`all_gather`, ...) over ICI within a slice and DCN across slices — no
+NCCL/MPI analogue is hand-rolled. Multi-host bring-up goes through
+``distributed.initialize_distributed``.
+"""
+
+from machine_learning_replications_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    single_device_mesh,
+)
+
+__all__ = ["DATA_AXIS", "MODEL_AXIS", "make_mesh", "single_device_mesh"]

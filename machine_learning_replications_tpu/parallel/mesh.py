@@ -1,0 +1,44 @@
+"""Device-mesh construction.
+
+One 2-D mesh serves the whole framework (axis semantics in the package
+docstring). On a single chip both axes are 1 and every ``shard_map`` /
+``pjit`` collapses to local compute — the same code path serves one chip,
+a v5e-8 slice, and a multi-host pod (mesh shape is config, not code).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data: int | None = None,
+    model: int = 1,
+    devices: list[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh.
+
+    ``data=None`` uses all remaining devices on the data axis. Devices are
+    laid out so that the model axis is innermost (fastest-varying), keeping
+    model-axis collectives on adjacent chips (ICI neighbours on a TPU slice).
+    """
+    devs = devices if devices is not None else jax.devices()
+    if data is None:
+        if len(devs) % model:
+            raise ValueError(f"{len(devs)} devices not divisible by model={model}")
+        data = len(devs) // model
+    n = data * model
+    if n > len(devs):
+        raise ValueError(f"mesh {data}x{model} needs {n} devices, have {len(devs)}")
+    grid = np.array(devs[:n]).reshape(data, model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(data=1, model=1)

@@ -1,0 +1,98 @@
+"""Data layer: schema, synthetic cohort marginals, .mat round-trip, sharding."""
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.data import (
+    COHORT_SCHEMA,
+    SELECTED_17,
+    load_data,
+    make_cohort,
+    pad_rows,
+    save_data,
+    selected_indices,
+    shard_rows,
+)
+
+
+def test_schema_shape():
+    assert len(COHORT_SCHEMA) == 64
+    assert len(SELECTED_17) == 17
+    idx = selected_indices()
+    assert len(set(idx)) == 17 and all(0 <= i < 64 for i in idx)
+
+
+def test_cohort_contract(cohort_full):
+    X, y, names = cohort_full
+    assert X.shape == (1427, 64) and X.dtype == np.float64
+    assert y.shape == (1427,) and set(np.unique(y)) <= {0.0, 1.0}
+    assert names.shape == (1, 64)
+    # names[0, mask] indexing must work as at train_ensemble_public.py:55
+    mask = np.zeros(64, bool)
+    mask[selected_indices()] = True
+    assert list(names[0, mask]) == [n for n in names[0] if n in SELECTED_17]
+
+
+def test_cohort_marginals(cohort_full):
+    X, y, _ = cohort_full
+    # Class prior near the pickle's 19.776 % positive
+    assert abs(y.mean() - 0.19776) < 0.04
+    # Binary prevalences near Table S1 (±5 pts at n=1427)
+    for j, spec in enumerate(COHORT_SCHEMA):
+        if spec.kind == "binary":
+            assert abs(X[:, j].mean() - spec.p) < 0.05, spec.name
+        elif spec.kind == "continuous":
+            assert abs(X[:, j].mean() - spec.mean) < max(1.0, 0.15 * spec.mean + 0.2 * spec.sd), spec.name
+
+
+def test_missingness():
+    X, _, _ = make_cohort(n=400, seed=1, missing_rate=0.1)
+    nonbin = [j for j, s in enumerate(COHORT_SCHEMA) if s.kind != "binary"]
+    binj = [j for j, s in enumerate(COHORT_SCHEMA) if s.kind == "binary"]
+    assert np.isnan(X[:, nonbin]).mean() > 0.05
+    assert not np.isnan(X[:, binj]).any()
+
+
+def test_determinism():
+    a = make_cohort(n=100, seed=7)[0]
+    b = make_cohort(n=100, seed=7)[0]
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("backend", ["scipy", "auto"])
+def test_mat_roundtrip(tmp_path, cohort, backend):
+    X, y, names = cohort
+    p = str(tmp_path / "cohort.mat")
+    save_data(p, X, y, names)
+    X2, y2, names2 = load_data(p, backend=backend)
+    np.testing.assert_allclose(X2, X, equal_nan=True)
+    np.testing.assert_allclose(y2, y)
+    def unwrap(c):
+        return str(np.ravel(c)[0]) if isinstance(c, np.ndarray) else str(c)
+
+    assert [unwrap(n) for n in np.ravel(names2)[:3]] == [str(n) for n in names[0, :3]]
+
+
+def test_pad_rows():
+    x = np.arange(10.0).reshape(5, 2)
+    p, n = pad_rows(x, 4)
+    assert p.shape == (8, 2) and n == 5
+    np.testing.assert_array_equal(p[:5], x)
+    assert (p[5:] == 0).all()
+    p2, n2 = pad_rows(x, 5)
+    assert p2.shape == (5, 2) and n2 == 5
+
+
+def test_shard_rows_8dev(cohort):
+    import jax
+    from machine_learning_replications_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh(data=4, model=2)
+    X, y, _ = cohort
+    Xd, yd = shard_rows(mesh, X, y)
+    assert Xd.shape[0] % 4 == 0
+    np.testing.assert_allclose(np.asarray(Xd)[: X.shape[0]], X, equal_nan=True)
+    # Sharded over the data axis only
+    assert Xd.sharding.spec[0] == "data" and Xd.sharding.spec[1] is None
