@@ -1,0 +1,43 @@
+"""The reference's example patient (``predict_hf.py:5-27``).
+
+The insertion order of this dict IS the model input contract — the 17
+Lasso-selected features in training order (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+EXAMPLE_PATIENT: dict[str, float] = {
+    "Obstructive HCM": 1,
+    "Gender": 1,
+    "Syncope": 0,
+    "Dyspnea": 0,
+    "Fatigue": 1,
+    "Presyncope": 0,
+    "NYHA_Class": 1,
+    "Atrial_Fibrillation": 1,
+    "Hypertension": 0,
+    "Beta_blocker": 0,
+    "Ca_Channel_Blockers": 0,
+    "ACEI_ARB": 0,
+    "Coumadin": 0,
+    "Max_Wall_Thick": 13,
+    "Septal_Anterior_Motion": 0,
+    "Mitral_Regurgitation": 0,
+    "Ejection_Fraction": 55,
+}
+
+
+# The dict's insertion order IS the model input contract; keep it locked to
+# the single source of truth in the schema.
+from machine_learning_replications_tpu.data.schema import SELECTED_17 as _SELECTED_17
+
+assert tuple(EXAMPLE_PATIENT) == _SELECTED_17, "example patient order drifted from schema"
+
+
+def patient_row(params: dict[str, float] | None = None) -> np.ndarray:
+    """Flatten a patient dict to the ``(1, 17)`` model input row, exactly as
+    ``predict_hf.py:29-31`` does."""
+    d = EXAMPLE_PATIENT if params is None else params
+    return np.reshape([d[k] for k in EXAMPLE_PATIENT], (1, -1)).astype(np.float64)

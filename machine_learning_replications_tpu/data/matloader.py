@@ -31,6 +31,8 @@ def load_data(
 
 
 def _read_mat(path: str, backend: str) -> tuple[np.ndarray, np.ndarray]:
+    if backend not in ("auto", "native", "scipy"):
+        raise ValueError(f"unknown backend {backend!r}; use auto | native | scipy")
     if backend in ("auto", "native"):
         try:
             from machine_learning_replications_tpu.native import matio

@@ -33,16 +33,23 @@ def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
 
 def shard_rows(
     mesh: Mesh, *arrays: np.ndarray, axis: str = "data"
-) -> tuple[jax.Array, ...] | jax.Array:
+) -> tuple[tuple[jax.Array, ...] | jax.Array, int]:
     """Place arrays on ``mesh`` with rows sharded over ``axis``.
 
-    Each array is padded so its row count divides the axis size; callers that
-    need the true row count should use ``pad_rows`` explicitly first.
+    Each array is padded with zero rows so its row count divides the axis
+    size. Returns ``(sharded, n_rows)`` — padding rows are *fabricated*
+    (e.g. outcome 0.0), so every consumer must mask reductions beyond
+    ``n_rows``; the count is part of the contract, not optional metadata.
     """
     n_shards = mesh.shape[axis]
     out = []
+    n_rows = None
     for a in arrays:
-        padded, _ = pad_rows(np.asarray(a), n_shards)
+        padded, n = pad_rows(np.asarray(a), n_shards)
+        if n_rows is None:
+            n_rows = n
+        elif n != n_rows:
+            raise ValueError(f"row-count mismatch: {n} vs {n_rows}")
         spec = P(axis, *([None] * (padded.ndim - 1)))
         out.append(jax.device_put(padded, NamedSharding(mesh, spec)))
-    return out[0] if len(out) == 1 else tuple(out)
+    return (out[0] if len(out) == 1 else tuple(out)), (n_rows or 0)

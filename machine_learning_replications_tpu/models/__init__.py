@@ -1,0 +1,22 @@
+"""L4 — the model layer (ensemble graph).
+
+Each member is a pair of pure functions over an explicit parameter pytree
+(``fit(...) -> params``, ``predict_proba(params, X) -> p``) — the functional
+JAX re-design of the sklearn estimator objects the reference composes at
+``train_ensemble_public.py:43-48``. Parameter pytrees are ``flax.struct``
+dataclasses: jit-traceable, shardable, Orbax-serializable.
+"""
+
+from machine_learning_replications_tpu.models.scaler import ScalerParams
+from machine_learning_replications_tpu.models.linear import LinearParams
+from machine_learning_replications_tpu.models.svm import SVCParams
+from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
+from machine_learning_replications_tpu.models.stacking import StackingParams
+
+__all__ = [
+    "ScalerParams",
+    "LinearParams",
+    "SVCParams",
+    "TreeEnsembleParams",
+    "StackingParams",
+]

@@ -1,0 +1,28 @@
+"""Logistic-regression members (inference side).
+
+Covers both the L1/liblinear base member (``train_ensemble_public.py:46``)
+and the L2/lbfgs meta learner (``:48``): at predict time both are
+``σ(X·coef + intercept)`` (SURVEY.md §3.4). Training lives in
+``models.solvers`` (FISTA for L1, Newton for L2).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+import jax.scipy.special
+
+
+@flax.struct.dataclass
+class LinearParams:
+    coef: jnp.ndarray       # [F]
+    intercept: jnp.ndarray  # scalar
+
+
+def decision_function(params: LinearParams, X: jnp.ndarray) -> jnp.ndarray:
+    return X @ params.coef + params.intercept
+
+
+def predict_proba1(params: LinearParams, X: jnp.ndarray) -> jnp.ndarray:
+    """P(class 1); the [1−p, p] pairing happens at the stacking layer."""
+    return jax.scipy.special.expit(decision_function(params, X))

@@ -1,0 +1,35 @@
+"""StandardScaler — z-scoring inside the SVC pipeline.
+
+Reference: ``make_pipeline(StandardScaler(), SVC(...))`` at
+``train_ensemble_public.py:44``; fitted stats live in the shipped pickle
+(``mean_`` / ``scale_`` over 17 features, n_samples_seen_=713).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class ScalerParams:
+    mean: jnp.ndarray   # [F]
+    scale: jnp.ndarray  # [F] — stddev, with zero-variance columns forced to 1
+
+
+def fit(X: jnp.ndarray, sample_weight: jnp.ndarray | None = None) -> ScalerParams:
+    """Population (ddof=0) moments, matching sklearn's StandardScaler."""
+    if sample_weight is None:
+        mean = jnp.mean(X, axis=0)
+        var = jnp.mean((X - mean) ** 2, axis=0)
+    else:
+        w = sample_weight / jnp.sum(sample_weight)
+        mean = w @ X
+        var = w @ (X - mean) ** 2
+    # sklearn maps zero variance → scale 1 so constant columns pass through.
+    scale = jnp.where(var > 0, jnp.sqrt(var), 1.0)
+    return ScalerParams(mean=mean, scale=scale)
+
+
+def transform(params: ScalerParams, X: jnp.ndarray) -> jnp.ndarray:
+    return (X - params.mean) / params.scale

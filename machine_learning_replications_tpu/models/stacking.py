@@ -1,0 +1,50 @@
+"""Stacking ensemble — the reference's L4 model graph.
+
+``StackingClassifier(estimators=[svc-pipeline, gbc, lg],
+final_estimator=LogisticRegression(class_weight='balanced'))``
+(``train_ensemble_public.py:43-48``). Inference composes the members exactly
+as SURVEY.md §3.4: each binary member contributes its P(class 1) as one
+meta-feature column (sklearn drops the class-0 column), and the meta
+logistic regression maps ``[p_svc, p_gbc, p_lg]`` to the final probability.
+
+Everything here is a pure jittable function of a ``StackingParams`` pytree;
+training orchestration (5-fold cross_val_predict meta-features) lives in
+``fit.py``.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from machine_learning_replications_tpu.models import linear, scaler, svm, tree
+
+
+@flax.struct.dataclass
+class StackingParams:
+    scaler: scaler.ScalerParams      # inside the SVC pipeline only
+    svc: svm.SVCParams
+    gbdt: tree.TreeEnsembleParams
+    logreg: linear.LinearParams      # L1 base member
+    meta: linear.LinearParams        # final estimator over 3 meta-features
+
+
+def member_probas(params: StackingParams, X: jnp.ndarray) -> jnp.ndarray:
+    """Meta-feature matrix ``[n, 3]`` = P(class 1) per member, in the
+    reference's estimator order (svc, gbc, lg)."""
+    p_svc = svm.predict_proba1(params.svc, scaler.transform(params.scaler, X))
+    p_gbc = tree.predict_proba1(params.gbdt, X)
+    p_lg = linear.predict_proba1(params.logreg, X)
+    return jnp.stack([p_svc, p_gbc, p_lg], axis=-1)
+
+
+def predict_proba1(params: StackingParams, X: jnp.ndarray) -> jnp.ndarray:
+    """Final P(class 1) for each row of ``X[n, 17]``."""
+    return linear.predict_proba1(params.meta, member_probas(params, X))
+
+
+def predict_proba(params: StackingParams, X: jnp.ndarray) -> jnp.ndarray:
+    """``[n, 2]`` = [1−p, p], matching sklearn's column layout
+    (``predict_hf.py:36-40`` reads column 1)."""
+    p = predict_proba1(params, X)
+    return jnp.stack([1.0 - p, p], axis=-1)

@@ -1,0 +1,114 @@
+"""RBF support-vector classifier (inference side).
+
+Reference member: ``SVC(class_weight='balanced', probability=True)`` inside a
+StandardScaler pipeline (``train_ensemble_public.py:44``), solved by libsvm
+(C++). Here the kernel evaluation is one MXU matmul against the support set
+(``ops.linalg.rbf_kernel``) and the probability path reproduces libsvm's
+binary semantics *exactly* — including its two quirks:
+
+  1. the pairwise Platt probability is clipped to ``[1e-7, 1 - 1e-7]``;
+  2. binary class probabilities still go through libsvm's iterative
+     pairwise-coupling solver (``multiclass_probability``), which stops at
+     tolerance ``0.005/k`` — so its output differs from the plain sigmoid by
+     up to ~3e-3. We replicate the iteration (vectorized over samples, with
+     per-sample converged-lane masking) rather than the closed form, to hold
+     bitwise-level parity with sklearn/libsvm ``predict_proba``.
+
+Sign conventions (verified empirically against sklearn on both label
+orderings): with the *public* pickled fields,
+``dec = K(X, SV) @ dual_coef + intercept`` and libsvm's internal decision
+value is ``f = -dec`` with internal label order ``[classes_[0], classes_[1]]``.
+Platt then gives ``r₀ = σ(-(A·f + B))`` as the pairwise probability of class 0.
+
+Training (dual QP + Platt calibration) lives in ``models.solvers.svc_fit``.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import expit
+
+from machine_learning_replications_tpu.ops.linalg import rbf_kernel
+
+_MIN_PROB = 1e-7  # libsvm svm_predict_probability clipping
+_COUPLING_MAX_ITER = 100  # libsvm: max(100, k)
+_COUPLING_EPS = 0.005 / 2  # libsvm: 0.005 / k, k = 2
+
+
+@flax.struct.dataclass
+class SVCParams:
+    support_vectors: jnp.ndarray  # [S, F] (in scaler-transformed space)
+    dual_coef: jnp.ndarray        # [S] — public-convention y_i α_i
+    intercept: jnp.ndarray        # scalar — public convention
+    gamma: jnp.ndarray            # scalar — fitted γ (1/(F·var) for 'scale')
+    prob_a: jnp.ndarray           # scalar — libsvm _probA
+    prob_b: jnp.ndarray           # scalar — libsvm _probB
+
+
+def decision_function(params: SVCParams, Xt: jnp.ndarray) -> jnp.ndarray:
+    """``dec[n]`` over *scaler-transformed* inputs; positive → class 1."""
+    K = rbf_kernel(Xt, params.support_vectors, params.gamma)
+    return K @ params.dual_coef + params.intercept
+
+
+def _binary_coupling(r0: jnp.ndarray) -> jnp.ndarray:
+    """libsvm ``multiclass_probability`` specialized to k=2, vectorized.
+
+    ``r0`` is the clipped pairwise probability of class 0. Returns P(class 1).
+    The exact optimum is ``p0 = r0``; libsvm stops the iteration early at
+    ``eps = 0.0025``, and parity requires replicating that trajectory from
+    the ``p = [0.5, 0.5]`` start, including the mid-update renormalizations.
+    """
+    r1 = 1.0 - r0
+    q00, q01, q11 = r1 * r1, -r1 * r0, r0 * r0
+
+    def body(_, state):
+        p0, p1, done = state
+        qp0 = q00 * p0 + q01 * p1
+        qp1 = q01 * p0 + q11 * p1
+        pqp = p0 * qp0 + p1 * qp1
+        err = jnp.maximum(jnp.abs(qp0 - pqp), jnp.abs(qp1 - pqp))
+        done = done | (err < _COUPLING_EPS)
+
+        # t = 0 update (libsvm also updates Qp[0] here; it is recomputed from
+        # p at the top of the next iteration, so we don't carry it)
+        diff = (-qp0 + pqp) / q00
+        n_p0 = p0 + diff
+        n_pqp = (pqp + diff * (2 * qp0 + diff * q00)) / ((1 + diff) ** 2)
+        n_qp1 = (qp1 + diff * q01) / (1 + diff)
+        n_p0, n_p1 = n_p0 / (1 + diff), p1 / (1 + diff)
+        # t = 1 update
+        diff = (-n_qp1 + n_pqp) / q11
+        n_p1 = n_p1 + diff
+        n_p0, n_p1 = n_p0 / (1 + diff), n_p1 / (1 + diff)
+
+        p0 = jnp.where(done, p0, n_p0)
+        p1 = jnp.where(done, p1, n_p1)
+        return p0, p1, done
+
+    p0 = jnp.full_like(r0, 0.5)
+    p1 = jnp.full_like(r0, 0.5)
+    done = jnp.zeros_like(r0, dtype=bool)
+    p0, p1, _ = jax.lax.fori_loop(0, _COUPLING_MAX_ITER, body, (p0, p1, done))
+    return p1
+
+
+def predict_proba1(params: SVCParams, Xt: jnp.ndarray) -> jnp.ndarray:
+    """P(class 1), exact libsvm binary semantics (see module docstring)."""
+    dec = decision_function(params, Xt)
+    f = -dec  # libsvm internal orientation
+    r0 = expit(-(params.prob_a * f + params.prob_b))
+    r0 = jnp.clip(r0, _MIN_PROB, 1.0 - _MIN_PROB)
+    return _binary_coupling(r0)
+
+
+def predict_proba1_sigmoid(params: SVCParams, Xt: jnp.ndarray) -> jnp.ndarray:
+    """Closed-form Platt probability (the coupling fixed point).
+
+    Within 3e-3 of ``predict_proba1`` and cheaper; use where sklearn-bitwise
+    parity is not required.
+    """
+    dec = decision_function(params, Xt)
+    return expit(params.prob_b - params.prob_a * dec)

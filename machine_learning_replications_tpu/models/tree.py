@@ -1,0 +1,74 @@
+"""Decision-tree ensembles as dense SoA tensors.
+
+The reference's trees are Cython ``sklearn.tree._tree.Tree`` objects (node
+structs with pointers, reached from ``GradientBoostingClassifier`` at
+``train_ensemble_public.py:45``). Here a forest is five same-shaped arrays —
+``feature/threshold/left/right/value``, each ``[n_trees, n_nodes]`` — so
+applying all trees to all rows is a pair of vectorized gathers, batched over
+trees with ``vmap``, with no data-dependent control flow (SURVEY.md §2.4:
+"tree arrays as dense JAX tensors (SoA)").
+
+Routing convention (sklearn-compatible): go left iff ``x[feature] <= threshold``.
+Leaves are self-loops (``left == right == self``), so descending ``max_depth``
+steps from the root always lands on — and stays at — the correct leaf.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class TreeEnsembleParams:
+    feature: jnp.ndarray    # [T, N] int32 — split feature (0 at leaves)
+    threshold: jnp.ndarray  # [T, N] float — split threshold (+inf at leaves)
+    left: jnp.ndarray       # [T, N] int32 — child if x[f] <= thr (self at leaves)
+    right: jnp.ndarray      # [T, N] int32 — child otherwise (self at leaves)
+    value: jnp.ndarray      # [T, N] float — leaf prediction (0 at internals)
+    init_raw: jnp.ndarray   # scalar — F₀ (prior log-odds for binomial deviance)
+    learning_rate: jnp.ndarray  # scalar — stage shrinkage (0.1 in the reference)
+    max_depth: int = flax.struct.field(pytree_node=False, default=1)
+
+
+def apply_one_tree(
+    feature: jnp.ndarray,
+    threshold: jnp.ndarray,
+    left: jnp.ndarray,
+    right: jnp.ndarray,
+    value: jnp.ndarray,
+    X: jnp.ndarray,
+    max_depth: int,
+) -> jnp.ndarray:
+    """Evaluate one tree on ``X[n, F]`` → leaf values ``[n]``.
+
+    ``max_depth`` unrolled descent steps; each step is two gathers and a
+    select — branch-free, so XLA vectorizes it across the whole batch.
+    """
+    idx = jnp.zeros(X.shape[0], dtype=jnp.int32)
+    rows = jnp.arange(X.shape[0])
+    for _ in range(max_depth):
+        f = feature[idx]
+        go_left = X[rows, f] <= threshold[idx]
+        idx = jnp.where(go_left, left[idx], right[idx])
+    return value[idx]
+
+
+def apply(params: TreeEnsembleParams, X: jnp.ndarray) -> jnp.ndarray:
+    """All trees on all rows → ``[T, n]`` leaf values (vmapped over trees)."""
+    X = jnp.asarray(X)
+    return jax.vmap(
+        lambda f, t, l, r, v: apply_one_tree(f, t, l, r, v, X, params.max_depth)
+    )(params.feature, params.threshold, params.left, params.right, params.value)
+
+
+def raw_score(params: TreeEnsembleParams, X: jnp.ndarray) -> jnp.ndarray:
+    """Boosted raw score: ``F₀ + lr · Σ_t tree_t(X)`` (SURVEY.md §3.4)."""
+    contrib = apply(params, X)  # [T, n]
+    return params.init_raw + params.learning_rate * jnp.sum(contrib, axis=0)
+
+
+def predict_proba1(params: TreeEnsembleParams, X: jnp.ndarray) -> jnp.ndarray:
+    """P(class 1) = σ(raw) — binomial-deviance link."""
+    return jax.scipy.special.expit(raw_score(params, X))

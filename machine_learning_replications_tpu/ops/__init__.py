@@ -1,0 +1,14 @@
+"""L0 — device compute primitives.
+
+The reference reaches its native compute through sklearn's C/C++/Cython
+internals (SURVEY.md §2.4); this package is their TPU-native replacement:
+MXU-friendly dense linear algebra, histogram/split kernels (XLA and Pallas
+backends), and device-side metrics.
+"""
+
+from machine_learning_replications_tpu.ops.linalg import (
+    pairwise_sq_dists,
+    rbf_kernel,
+)
+
+__all__ = ["pairwise_sq_dists", "rbf_kernel"]

@@ -1,0 +1,30 @@
+"""L1 — persistence.
+
+The reference persists one opaque pickle of the whole fitted sklearn object
+graph (``predict_hf.py:33-34``; ``HF/hf_predict_model.pkl``). Here the model
+state is an explicit ``StackingParams`` pytree checkpointed with Orbax
+(``orbax_io``), plus a one-way import tool (``sklearn_import``) that decodes
+legacy sklearn pickles — including the shipped 0.23.2 artifact — *without
+executing any pickled code* and converts them (or live sklearn estimators)
+into pytrees, seeding the numerical parity oracle of SURVEY.md §2.3.
+"""
+
+from machine_learning_replications_tpu.persist.sklearn_import import (
+    REFERENCE_PKL_PATH,
+    decode_pickle,
+    import_stacking,
+    import_gbdt,
+    import_linear,
+    import_scaler,
+    import_svc,
+)
+
+__all__ = [
+    "REFERENCE_PKL_PATH",
+    "decode_pickle",
+    "import_stacking",
+    "import_gbdt",
+    "import_linear",
+    "import_scaler",
+    "import_svc",
+]

@@ -1,0 +1,253 @@
+"""Legacy sklearn pickle import — the parity-oracle loader.
+
+The shipped model (``HF/hf_predict_model.pkl``, sklearn 0.23.2, pickle
+protocol 3) cannot be loaded by a modern sklearn, and executing 15-year-old
+pickled object graphs is unnecessary anyway: we only need the fitted arrays.
+``decode_pickle`` deserializes with a *class-stubbing* unpickler — numpy
+globals resolve for real (so ndarrays reconstruct), every sklearn class
+becomes an inert attribute bag — and the ``import_*`` converters duck-type
+those bags into our pytrees.
+
+The same converters accept live fitted sklearn estimators (they read the
+same attributes), which is how the differential tests translate
+sklearn-1.9-fitted models into JAX parameters.
+
+Field conventions handled here (verified empirically; see models/svm.py):
+  * binary SVC's public ``dual_coef_``/``intercept_`` are the negation of the
+    private ``_dual_coef_``/``_intercept_``; the public pair satisfies
+    ``dec = K @ dual_coef + intercept``;
+  * GBC trees store sklearn node structs ``(left_child, right_child, feature,
+    threshold, ...)``; leaves have children == -1 and are converted to
+    self-loops for the branch-free descent in ``models.tree``.
+"""
+
+from __future__ import annotations
+
+import builtins
+import io
+import pickle
+from typing import Any
+
+import numpy as np
+
+from machine_learning_replications_tpu.models.linear import LinearParams
+from machine_learning_replications_tpu.models.scaler import ScalerParams
+from machine_learning_replications_tpu.models.stacking import StackingParams
+from machine_learning_replications_tpu.models.svm import SVCParams
+from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
+
+REFERENCE_PKL_PATH = (
+    "/root/reference/Machine Learning for Predicting Heart Failure Progression/"
+    "hf_predict_model.pkl"
+)
+
+
+class _Stub(dict):
+    """Inert stand-in for a pickled class: records ctor args and state.
+
+    Subclasses ``dict`` so dict-subclass pickles (e.g. ``sklearn.utils.Bunch``)
+    replay their SETITEMS opcodes; attribute lookup falls back to dict keys,
+    matching Bunch semantics.
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__()
+        self._ctor_args = args
+        self._ctor_kwargs = kwargs
+
+    def __setstate__(self, state: Any) -> None:
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        else:
+            self._state = state
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<stub {type(self).__module__}.{type(self).__name__}>"
+
+
+# Only array-reconstruction machinery and inert containers resolve for real —
+# notably NOT builtins.* wholesale (builtins.exec/eval would make the
+# "no pickled code executes" guarantee false for a crafted pickle).
+_SAFE_GLOBALS: dict[tuple[str, str], Any] = {
+    ("builtins", n): getattr(builtins, n)
+    for n in (
+        "object", "tuple", "list", "dict", "set", "frozenset",
+        "bytearray", "complex", "bytes", "str", "int", "float", "bool",
+        "slice", "range",
+    )
+}
+
+
+class _StubUnpickler(pickle.Unpickler):
+    """Resolve numpy/scipy + inert builtins for real; stub everything else."""
+
+    def __init__(self, f: io.IOBase) -> None:
+        super().__init__(f)
+        self._stubs: dict[tuple[str, str], type] = {}
+
+    def find_class(self, module: str, name: str) -> Any:
+        if module.split(".")[0] in ("numpy", "scipy"):
+            return super().find_class(module, name)
+        if (module, name) in _SAFE_GLOBALS:
+            return _SAFE_GLOBALS[(module, name)]
+        if (module, name) == ("collections", "OrderedDict"):
+            import collections
+
+            return collections.OrderedDict
+        key = (module, name)
+        if key not in self._stubs:
+            cls = type(name, (_Stub,), {"__module__": module})
+            self._stubs[key] = cls
+        return self._stubs[key]
+
+
+def decode_pickle(path: str = REFERENCE_PKL_PATH) -> Any:
+    """Decode a (possibly ancient) sklearn pickle into stub attribute bags."""
+    with open(path, "rb") as f:
+        return _StubUnpickler(f).load()
+
+
+# ---------------------------------------------------------------------------
+# Converters: stub bag OR live sklearn estimator → parameter pytree
+# ---------------------------------------------------------------------------
+
+
+def _arr(x: Any) -> np.ndarray:
+    return np.asarray(x, dtype=np.float64)
+
+
+def import_scaler(obj: Any) -> ScalerParams:
+    return ScalerParams(mean=_arr(obj.mean_), scale=_arr(obj.scale_))
+
+
+def import_svc(obj: Any) -> SVCParams:
+    try:
+        dual = _arr(obj.dual_coef_)[0]
+        intercept = _arr(obj.intercept_).reshape(())
+    except AttributeError:  # only the private (libsvm-orientation) fields present
+        dual = -_arr(obj._dual_coef_)[0]
+        intercept = -_arr(obj._intercept_).reshape(())
+    return SVCParams(
+        support_vectors=_arr(obj.support_vectors_),
+        dual_coef=dual,
+        intercept=intercept,
+        gamma=_arr(obj._gamma).reshape(()),
+        prob_a=_arr(obj._probA).reshape(()),
+        prob_b=_arr(obj._probB).reshape(()),
+    )
+
+
+def _tree_arrays(tree_obj: Any) -> dict[str, np.ndarray]:
+    """Node arrays from a live ``sklearn.tree._tree.Tree`` or its stub.
+
+    Stubs hold the pickled state dict: ``nodes`` is the structured node
+    array, ``values`` is ``[node_count, 1, 1]``.
+    """
+    if hasattr(tree_obj, "nodes"):  # stub path
+        nodes = tree_obj.nodes
+        return {
+            "feature": np.asarray(nodes["feature"], np.int32),
+            "threshold": _arr(nodes["threshold"]),
+            "left": np.asarray(nodes["left_child"], np.int32),
+            "right": np.asarray(nodes["right_child"], np.int32),
+            "value": _arr(tree_obj.values)[:, 0, 0],
+        }
+    return {
+        "feature": np.asarray(tree_obj.feature, np.int32),
+        "threshold": _arr(tree_obj.threshold),
+        "left": np.asarray(tree_obj.children_left, np.int32),
+        "right": np.asarray(tree_obj.children_right, np.int32),
+        "value": _arr(tree_obj.value)[:, 0, 0],
+    }
+
+
+def import_gbdt(obj: Any) -> TreeEnsembleParams:
+    """GradientBoostingClassifier (binary) → dense SoA forest.
+
+    Leaves (children == -1) become self-loops with +inf thresholds so the
+    fixed-depth descent parks on them; shorter trees are padded with inert
+    nodes to the ensemble-wide max node count.
+    """
+    estimators = np.asarray(obj.estimators_).ravel()
+    trees = [_tree_arrays(e.tree_) for e in estimators]
+    n_nodes = max(t["feature"].shape[0] for t in trees)
+    T = len(trees)
+    feature = np.zeros((T, n_nodes), np.int32)
+    threshold = np.full((T, n_nodes), np.inf)
+    left = np.tile(np.arange(n_nodes, dtype=np.int32), (T, 1))
+    right = left.copy()
+    value = np.zeros((T, n_nodes))
+    max_depth = 1
+    for i, t in enumerate(trees):
+        k = t["feature"].shape[0]
+        is_leaf = t["left"] < 0
+        idx = np.arange(k, dtype=np.int32)
+        feature[i, :k] = np.where(is_leaf, 0, t["feature"])
+        threshold[i, :k] = np.where(is_leaf, np.inf, t["threshold"])
+        left[i, :k] = np.where(is_leaf, idx, t["left"])
+        right[i, :k] = np.where(is_leaf, idx, t["right"])
+        value[i, :k] = t["value"]
+        # depth of this tree = longest root→leaf path
+        depth = _tree_depth(t["left"], t["right"])
+        max_depth = max(max_depth, depth)
+
+    prior1 = _class_prior1(obj.init_)
+    init_raw = np.log(prior1 / (1.0 - prior1))
+    return TreeEnsembleParams(
+        feature=feature,
+        threshold=threshold,
+        left=left,
+        right=right,
+        value=value,
+        init_raw=np.float64(init_raw),
+        learning_rate=np.float64(obj.learning_rate),
+        max_depth=int(max_depth),
+    )
+
+
+def _tree_depth(left: np.ndarray, right: np.ndarray) -> int:
+    depth = np.zeros(left.shape[0], np.int32)
+    order = range(left.shape[0])  # sklearn stores parents before children
+    for i in order:
+        for c in (left[i], right[i]):
+            if c >= 0 and c != i:
+                depth[c] = depth[i] + 1
+    return int(depth.max()) if depth.size else 0
+
+
+def _class_prior1(init_obj: Any) -> float:
+    prior = _arr(init_obj.class_prior_)
+    return float(prior[1])
+
+
+def import_linear(obj: Any) -> LinearParams:
+    return LinearParams(
+        coef=_arr(obj.coef_)[0], intercept=_arr(obj.intercept_).reshape(())
+    )
+
+
+def _pipeline_steps(obj: Any) -> list[Any]:
+    return [s[1] for s in obj.steps]
+
+
+def import_stacking(obj: Any) -> StackingParams:
+    """StackingClassifier (fitted, reference topology) → StackingParams.
+
+    Expects the reference's member order (``train_ensemble_public.py:43-47``):
+    [Pipeline(StandardScaler, SVC), GradientBoostingClassifier, LogisticRegression].
+    """
+    pipe, gbc, lg = list(obj.estimators_)
+    sc, svc = _pipeline_steps(pipe)
+    return StackingParams(
+        scaler=import_scaler(sc),
+        svc=import_svc(svc),
+        gbdt=import_gbdt(gbc),
+        logreg=import_linear(lg),
+        meta=import_linear(obj.final_estimator_),
+    )

@@ -91,7 +91,8 @@ def test_shard_rows_8dev(cohort):
         pytest.skip("needs 8 virtual devices")
     mesh = make_mesh(data=4, model=2)
     X, y, _ = cohort
-    Xd, yd = shard_rows(mesh, X, y)
+    (Xd, yd), n_rows = shard_rows(mesh, X, y)
+    assert n_rows == X.shape[0]
     assert Xd.shape[0] % 4 == 0
     np.testing.assert_allclose(np.asarray(Xd)[: X.shape[0]], X, equal_nan=True)
     # Sharded over the data axis only

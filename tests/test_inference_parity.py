@@ -1,0 +1,222 @@
+"""Inference parity: our JAX predict paths vs sklearn, and vs the shipped pickle.
+
+Strategy (SURVEY.md §4): fit *live* sklearn estimators on synthetic data,
+convert their fitted state into our pytrees with the same converters used for
+the legacy pickle, and demand (near-)bitwise agreement of predict_proba.
+Then decode the shipped sklearn-0.23.2 artifact and check the decoded
+constants against SURVEY.md §2.3 plus a closed-form numpy recomputation of
+the stacked probability on the reference's example patient.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from machine_learning_replications_tpu.data.examples import EXAMPLE_PATIENT, patient_row
+from machine_learning_replications_tpu.models import linear, scaler, stacking, svm, tree
+from machine_learning_replications_tpu.persist import (
+    REFERENCE_PKL_PATH,
+    decode_pickle,
+    import_gbdt,
+    import_linear,
+    import_scaler,
+    import_stacking,
+    import_svc,
+)
+
+
+@pytest.fixture(scope="module")
+def fit_data():
+    rng = np.random.default_rng(42)
+    n, f = 400, 17
+    X = rng.normal(size=(n, f))
+    X[:, :10] = (X[:, :10] > 0.3).astype(float)  # mostly-binary like the cohort
+    w = rng.normal(size=f)
+    y = (X @ w + rng.normal(size=n) > 0.2).astype(float)
+    Xq = rng.normal(size=(100, f))
+    Xq[:, :10] = (Xq[:, :10] > 0.3).astype(float)
+    return X, y, Xq
+
+
+def test_svc_parity(fit_data):
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    X, y, Xq = fit_data
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pipe = make_pipeline(
+            StandardScaler(),
+            SVC(class_weight="balanced", probability=True, random_state=2020),
+        ).fit(X, y)
+    sk_sc, sk_svc = pipe.steps[0][1], pipe.steps[1][1]
+    sp = import_scaler(sk_sc)
+    vp = import_svc(sk_svc)
+
+    Xt = scaler.transform(sp, Xq)
+    np.testing.assert_allclose(
+        np.asarray(Xt), sk_sc.transform(Xq), rtol=1e-12, atol=1e-12
+    )
+    dec = svm.decision_function(vp, Xt)
+    np.testing.assert_allclose(
+        np.asarray(dec), sk_svc.decision_function(sk_sc.transform(Xq)), rtol=1e-9, atol=1e-11
+    )
+    # Exact libsvm binary probability (incl. coupling iteration + clipping)
+    p1 = jax.jit(svm.predict_proba1)(vp, Xt)
+    p_ref = pipe.predict_proba(Xq)[:, 1]
+    np.testing.assert_allclose(np.asarray(p1), p_ref, rtol=1e-10, atol=1e-12)
+    # Closed-form sigmoid within the coupling solver's tolerance
+    p_sig = svm.predict_proba1_sigmoid(vp, Xt)
+    assert np.abs(np.asarray(p_sig) - p_ref).max() < 5e-3
+
+
+@pytest.mark.parametrize("max_depth", [1, 3])
+def test_gbdt_parity(fit_data, max_depth):
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y, Xq = fit_data
+    gbc = GradientBoostingClassifier(
+        n_estimators=50, max_depth=max_depth, random_state=2020
+    ).fit(X, y)
+    tp = import_gbdt(gbc)
+    assert tp.max_depth == max_depth  # these fits always reach their depth cap
+    raw = jax.jit(tree.raw_score)(tp, Xq)
+    np.testing.assert_allclose(
+        np.asarray(raw), gbc.decision_function(Xq), rtol=1e-12, atol=1e-12
+    )
+    p1 = tree.predict_proba1(tp, Xq)
+    np.testing.assert_allclose(
+        np.asarray(p1), gbc.predict_proba(Xq)[:, 1], rtol=1e-12, atol=1e-12
+    )
+
+
+def test_logreg_parity(fit_data):
+    from sklearn.linear_model import LogisticRegression
+
+    X, y, Xq = fit_data
+    lr = LogisticRegression(
+        class_weight="balanced", penalty="l1", solver="liblinear"
+    ).fit(X, y)
+    lp = import_linear(lr)
+    p1 = jax.jit(linear.predict_proba1)(lp, Xq)
+    np.testing.assert_allclose(
+        np.asarray(p1), lr.predict_proba(Xq)[:, 1], rtol=1e-12, atol=1e-12
+    )
+
+
+@pytest.fixture(scope="module")
+def sk_stacking(fit_data):
+    from sklearn.ensemble import GradientBoostingClassifier, StackingClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    X, y, _ = fit_data
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = StackingClassifier(
+            estimators=[
+                (
+                    "svc",
+                    make_pipeline(
+                        StandardScaler(),
+                        SVC(class_weight="balanced", probability=True, random_state=2020),
+                    ),
+                ),
+                ("gbc", GradientBoostingClassifier(n_estimators=50, max_depth=1, random_state=2020)),
+                ("lg", LogisticRegression(class_weight="balanced", penalty="l1", solver="liblinear")),
+            ],
+            final_estimator=LogisticRegression(class_weight="balanced"),
+        ).fit(X, y)
+    return clf
+
+
+def test_stacking_parity(fit_data, sk_stacking):
+    _, _, Xq = fit_data
+    params = import_stacking(sk_stacking)
+    p = jax.jit(stacking.predict_proba)(params, Xq)
+    p_ref = sk_stacking.predict_proba(Xq)
+    np.testing.assert_allclose(np.asarray(p), p_ref, rtol=1e-9, atol=1e-11)
+
+
+# ---------------------------------------------------------------------------
+# The shipped 0.23.2 artifact — the reference's parity oracle (SURVEY.md §2.3)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shipped_params():
+    return import_stacking(decode_pickle(REFERENCE_PKL_PATH))
+
+
+def test_decoded_constants(shipped_params):
+    p = shipped_params
+    # Meta-LR weights for [svc, gbc, lg] and intercept (SURVEY.md §2.3)
+    np.testing.assert_allclose(
+        np.asarray(p.meta.coef), [1.83724, 0.41021, 2.88042], atol=1e-4
+    )
+    np.testing.assert_allclose(float(p.meta.intercept), -1.98943, atol=1e-4)
+    assert p.svc.support_vectors.shape == (434, 17)
+    np.testing.assert_allclose(float(p.svc.intercept), -0.09879, atol=1e-4)
+    np.testing.assert_allclose(float(p.svc.prob_a), -1.25858, atol=1e-4)
+    np.testing.assert_allclose(float(p.svc.prob_b), -1.18972, atol=1e-4)
+    np.testing.assert_allclose(float(p.svc.gamma), 1 / 17, atol=1e-6)
+    assert p.gbdt.feature.shape[0] == 100 and p.gbdt.max_depth == 1
+    np.testing.assert_allclose(float(p.gbdt.init_raw), -1.4005, atol=1e-3)
+    np.testing.assert_allclose(float(p.gbdt.learning_rate), 0.1)
+    # Stump 0 splits Dyspnea (feature 3) at 0.5 with leaves [-0.77138, +0.97464]
+    assert int(p.gbdt.feature[0, 0]) == 3
+    np.testing.assert_allclose(float(p.gbdt.threshold[0, 0]), 0.5)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(p.gbdt.value[0, 1:3])), [-0.77138, 0.97464], atol=1e-4
+    )
+    # L1-LR coefs
+    np.testing.assert_allclose(
+        np.asarray(p.logreg.coef)[:3], [1.1247, -0.2490, 0.3900], atol=1e-3
+    )
+
+
+def test_shipped_model_inference(shipped_params):
+    """predict_hf.py equivalent: stacked probability for the example patient,
+    cross-checked against an independent closed-form numpy recomputation."""
+    X = patient_row()
+    p = float(stacking.predict_proba1(shipped_params, X)[0])
+    assert 0.0 < p < 1.0
+
+    # Independent numpy recomputation (SURVEY.md §3.4) — no JAX involved.
+    sp = shipped_params
+    z = (X - np.asarray(sp.scaler.mean)) / np.asarray(sp.scaler.scale)
+    K = np.exp(
+        -float(sp.svc.gamma)
+        * ((z[:, None, :] - np.asarray(sp.svc.support_vectors)[None]) ** 2).sum(-1)
+    )
+    dec = K @ np.asarray(sp.svc.dual_coef) + float(sp.svc.intercept)
+    p_svc = 1 / (1 + np.exp(float(sp.svc.prob_a) * dec - float(sp.svc.prob_b)))
+    raw = float(sp.gbdt.init_raw)
+    for t in range(100):
+        f0 = int(sp.gbdt.feature[t, 0])
+        thr = float(sp.gbdt.threshold[t, 0])
+        lchild = int(sp.gbdt.left[t, 0])
+        rchild = int(sp.gbdt.right[t, 0])
+        leaf = lchild if X[0, f0] <= thr else rchild
+        raw += 0.1 * float(sp.gbdt.value[t, leaf])
+    p_gbc = 1 / (1 + np.exp(-raw))
+    p_lg = 1 / (1 + np.exp(-(X @ np.asarray(sp.logreg.coef) + float(sp.logreg.intercept))))
+    meta = np.array([p_svc[0], p_gbc, p_lg[0]])
+    p_np = 1 / (1 + np.exp(-(meta @ np.asarray(sp.meta.coef) + float(sp.meta.intercept))))
+    # SVC coupling vs sigmoid differ by <3e-3; meta weights amplify slightly
+    assert abs(p - p_np) < 2e-2
+    # And the printed contract of predict_hf.py:38-40
+    print(f"Probability of progressive HF is: {100 * p:.2f} %")
+
+
+def test_example_patient_contract():
+    row = patient_row()
+    assert row.shape == (1, 17)
+    assert row[0, 13] == 13.0 and row[0, 16] == 55.0
+    assert list(EXAMPLE_PATIENT)[0] == "Obstructive HCM"
