@@ -1,0 +1,54 @@
+"""L3 — Lasso-based feature selection.
+
+Reference: ``LassoCV(random_state=2020, cv=10)`` wrapped in
+``SelectFromModel(threshold=-inf, max_features=17)``
+(``train_ensemble_public.py:51-55``): pick the top-17 features of 64 by
+|lasso coefficient| at the CV-chosen alpha, then column-subset X and the
+feature-name row. ``random_state`` is dead weight in the reference — with
+``cv=10`` an int, KFold doesn't shuffle, so the procedure is deterministic;
+our replication is deterministic by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from machine_learning_replications_tpu.config import LassoSelectConfig
+from machine_learning_replications_tpu.models import solvers
+
+
+def fit_select(
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: LassoSelectConfig = LassoSelectConfig(),
+) -> tuple[np.ndarray, dict[str, Any]]:
+    """Returns ``(support_mask [F] bool, info)`` like ``sfm.get_support()``."""
+    coef, intercept, alpha_, alphas, mse_path = solvers.lasso_cv(
+        jnp.asarray(X),
+        jnp.asarray(y),
+        cv_folds=cfg.cv_folds,
+        n_alphas=cfg.n_alphas,
+        eps=cfg.eps,
+        n_iter=cfg.max_iter,
+    )
+    mask = select_top_k(np.asarray(coef), cfg.max_features)
+    info = {
+        "coef": np.asarray(coef),
+        "intercept": float(intercept),
+        "alpha_": float(alpha_),
+        "alphas": np.asarray(alphas),
+        "mse_path": np.asarray(mse_path),
+    }
+    return mask, info
+
+
+def select_top_k(coef: np.ndarray, k: int) -> np.ndarray:
+    """sklearn SelectFromModel(threshold=-inf, max_features=k): top-k by
+    |coef|, stable argsort (ties → higher index wins, as in sklearn)."""
+    scores = np.abs(coef)
+    mask = np.zeros(scores.shape[0], dtype=bool)
+    mask[np.argsort(scores, kind="stable")[-k:]] = True
+    return mask

@@ -1,0 +1,293 @@
+"""Gradient-boosted trees — training (the north-star centerpiece).
+
+Reference member: ``GradientBoostingClassifier(n_estimators=100, max_depth=1,
+random_state=2020)`` (``train_ensemble_public.py:45``), solved by sklearn's
+Cython tree builder. This is the TPU-native re-design (SURVEY.md §7.4):
+
+  * features quantized once (``ops.binning``; exact-midpoint regime on the
+    HF cohort ⇒ sklearn-identical thresholds);
+  * each boosting stage builds its tree level-by-level with vectorized
+    per-(node, feature, bin) histograms and friedman split selection
+    (``ops.histogram``) — no data-dependent Python control flow;
+  * the stage loop is a ``lax.fori_loop`` writing into preallocated
+    ``[n_stages, n_nodes]`` forest tensors, so the whole fit is one XLA
+    program (device round-trips stay out of the loop — SURVEY.md §7
+    "latency-bound at 713 rows");
+  * trees live in heap layout (root 0, children 2i+1/2i+2); non-split nodes
+    self-loop, matching ``models.tree``'s fixed-depth descent.
+
+Numerics match sklearn's binomial-deviance GBC: F₀ = prior log-odds,
+residual r = y − σ(F), leaves re-valued by the Newton step Σr / Σp(1−p)
+(guarded at |den| < 1e-150 like ``_update_terminal_region``), stage update
+F += lr·leaf, and ``train_deviance[m] = −2·mean(y·F − log(1+eᶠ))`` — the
+pickle's ``train_score_`` trajectory definition (0.23-era full deviance;
+modern sklearn records half of it).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from machine_learning_replications_tpu.config import GBDTConfig
+from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
+from machine_learning_replications_tpu.ops import binning, histogram
+
+_NEWTON_DEN_GUARD = 1e-150  # sklearn _update_terminal_region zero guard
+
+
+def fit(
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: GBDTConfig = GBDTConfig(),
+    bins: binning.BinnedFeatures | None = None,
+) -> tuple[TreeEnsembleParams, dict[str, Any]]:
+    """Fit the boosted ensemble; returns (params, aux) with the deviance path."""
+    if bins is None:
+        bins = binning.bin_features(np.asarray(X), cfg.n_bins)
+    if cfg.max_depth == 1:
+        # Gather/scatter-free fast path: replicated sorted layout
+        # (ops.histogram.StumpData) — every stage is dense [F, n] math.
+        sd = histogram.build_stump_data(bins, y)
+        feature, threshold, value, is_split, deviance = _fit_stumps(
+            sd,
+            n_stages=cfg.n_estimators,
+            learning_rate=cfg.learning_rate,
+            min_samples_split=cfg.min_samples_split,
+            min_samples_leaf=cfg.min_samples_leaf,
+        )
+    else:
+        feature, threshold, value, is_split, deviance = _fit_binned(
+            jnp.asarray(bins.binned),
+            jnp.asarray(bins.thresholds),
+            jnp.asarray(y),
+            n_stages=cfg.n_estimators,
+            depth=cfg.max_depth,
+            max_bins=bins.max_bins,
+            learning_rate=cfg.learning_rate,
+            min_samples_split=cfg.min_samples_split,
+            min_samples_leaf=cfg.min_samples_leaf,
+        )
+    params = forest_to_params(
+        feature, threshold, value, is_split,
+        init_raw=_prior_log_odds(y), learning_rate=cfg.learning_rate,
+        max_depth=cfg.max_depth,
+    )
+    return params, {"train_deviance": np.asarray(deviance)}
+
+
+def _prior_log_odds(y: np.ndarray) -> np.ndarray:
+    p1 = float(np.mean(y))
+    return np.asarray(np.log(p1 / (1.0 - p1)))
+
+
+def forest_to_params(
+    feature: jnp.ndarray,    # [M, NN] int32
+    threshold: jnp.ndarray,  # [M, NN]
+    value: jnp.ndarray,      # [M, NN]
+    is_split: jnp.ndarray,   # [M, NN] bool
+    init_raw: np.ndarray,
+    learning_rate: float,
+    max_depth: int,
+) -> TreeEnsembleParams:
+    """Heap-layout forest tensors → the inference pytree (self-loop leaves)."""
+    M, NN = feature.shape
+    idx = jnp.arange(NN, dtype=jnp.int32)[None, :]
+    left = jnp.where(is_split, 2 * idx + 1, idx).astype(jnp.int32)
+    right = jnp.where(is_split, 2 * idx + 2, idx).astype(jnp.int32)
+    return TreeEnsembleParams(
+        feature=feature,
+        threshold=threshold,
+        left=left,
+        right=right,
+        value=value,
+        init_raw=jnp.asarray(init_raw),
+        learning_rate=jnp.asarray(learning_rate),
+        max_depth=max_depth,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_stages", "learning_rate", "min_samples_split", "min_samples_leaf"
+    ),
+)
+def _fit_stumps(
+    sd: histogram.StumpData,
+    *,
+    n_stages: int,
+    learning_rate: float,
+    min_samples_split: int,
+    min_samples_leaf: int,
+):
+    """Depth-1 boosting (the reference's exact config) on the replicated
+    sorted layout: each stage is a handful of dense [F, n] passes — expit,
+    cumsum, static boundary lookups, one compare — with no dynamic
+    gather/scatter anywhere (TPU serializes those onto the scalar unit)."""
+    F, n = sd.y_sorted.shape
+    dtype = sd.thresholds.dtype
+    ys = sd.y_sorted.astype(dtype)                # [F, n]
+    p1 = jnp.mean(ys[0])
+    f0 = jnp.log(p1 / (1.0 - p1))
+    CL = sd.left_count.astype(dtype)[None]        # [1, F, B-1] — static counts
+    CT = jnp.asarray([n], dtype)
+
+    def stage(t, carry):
+        raw, feats, thrs, vals, splits, devs = carry   # raw: [F, n] replicated
+        p = jax.scipy.special.expit(raw)
+        g = ys - p                                      # [F, n]
+        h = p * (1.0 - p)
+        GL = histogram.cumulative_boundary_sums(g, sd.left_count)[None]
+        HL = histogram.cumulative_boundary_sums(h, sd.left_count)[None]
+        GT = jnp.sum(g[0])
+        HT = jnp.sum(h[0])
+        sp = histogram.select_splits(
+            GL, CL, GT[None], CT, jnp.sum(g[0] * g[0])[None], sd.thresholds,
+            min_samples_split, min_samples_leaf,
+        )
+        do = sp.do_split[0]
+        fstar, bstar = sp.feature[0], sp.boundary[0]
+        num_l = GL[0, fstar, bstar]
+        den_l = HL[0, fstar, bstar]
+        num_r, den_r = GT - num_l, HT - den_l
+
+        def newton(num, den):
+            return jnp.where(
+                jnp.abs(den) < _NEWTON_DEN_GUARD,
+                0.0,
+                num / jnp.where(jnp.abs(den) < _NEWTON_DEN_GUARD, 1.0, den),
+            )
+
+        v_root = newton(GT, HT)  # unsplit stage: single-leaf Newton value
+        v_l, v_r = newton(num_l, den_l), newton(num_r, den_r)
+
+        # bins of feature f* in every sort order: dense dynamic-slice + compare
+        split_bins = jax.lax.dynamic_index_in_dim(
+            sd.bins_x, fstar, axis=0, keepdims=False
+        )  # [F, n] uint8
+        go_left = split_bins <= bstar.astype(jnp.uint8)
+        contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
+        raw = raw + learning_rate * contrib
+        dev = -2.0 * jnp.mean(ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0]))
+
+        feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)
+        thr_t = jnp.stack([jnp.where(do, sp.threshold[0], jnp.inf),
+                           jnp.array(jnp.inf, dtype), jnp.array(jnp.inf, dtype)])
+        val_t = jnp.stack([jnp.where(do, 0.0, v_root),
+                           jnp.where(do, v_l, 0.0), jnp.where(do, v_r, 0.0)])
+        split_t = jnp.stack([do, jnp.array(False), jnp.array(False)])
+        return (
+            raw,
+            feats.at[t].set(feat_t),
+            thrs.at[t].set(thr_t.astype(dtype)),
+            vals.at[t].set(val_t.astype(dtype)),
+            splits.at[t].set(split_t),
+            devs.at[t].set(dev),
+        )
+
+    init = (
+        jnp.full((F, n), f0, dtype),
+        jnp.zeros((n_stages, 3), jnp.int32),
+        jnp.full((n_stages, 3), jnp.inf, dtype),
+        jnp.zeros((n_stages, 3), dtype),
+        jnp.zeros((n_stages, 3), bool),
+        jnp.zeros(n_stages, dtype),
+    )
+    _, feats, thrs, vals, splits, devs = jax.lax.fori_loop(0, n_stages, stage, init)
+    return feats, thrs, vals, splits, devs
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_stages", "depth", "max_bins",
+        "min_samples_split", "min_samples_leaf",
+    ),
+)
+def _fit_binned(
+    binned: jnp.ndarray,      # [n, F] int32
+    thresholds: jnp.ndarray,  # [F, B-1]
+    y: jnp.ndarray,           # [n] ∈ {0, 1}
+    *,
+    n_stages: int,
+    depth: int,
+    max_bins: int,
+    learning_rate: float,
+    min_samples_split: int,
+    min_samples_leaf: int,
+):
+    n, F = binned.shape
+    NN = 2 ** (depth + 1) - 1
+    dtype = thresholds.dtype
+    yf = y.astype(dtype)
+    p1 = jnp.mean(yf)
+    f0 = jnp.log(p1 / (1.0 - p1))
+    rows = jnp.arange(n)
+
+    def grow_tree(g, h):
+        """One stage's tree: level-synchronous growth over static depth."""
+        node = jnp.zeros(n, jnp.int32)
+        feat_t = jnp.zeros(NN, jnp.int32)
+        thr_t = jnp.full(NN, jnp.inf, dtype)
+        split_t = jnp.zeros(NN, bool)
+        for level in range(depth):
+            base = 2**level - 1
+            K = 2**level
+            node_local = jnp.where(node >= base, node - base, -1)
+            hists = histogram.node_histograms(binned, node_local, g, h, K, max_bins)
+            sp = histogram.best_splits(
+                hists, thresholds, min_samples_split, min_samples_leaf
+            )
+            feat_t = jax.lax.dynamic_update_slice(
+                feat_t, jnp.where(sp.do_split, sp.feature, 0), (base,)
+            )
+            thr_t = jax.lax.dynamic_update_slice(
+                thr_t, jnp.where(sp.do_split, sp.threshold, jnp.inf).astype(dtype), (base,)
+            )
+            split_t = jax.lax.dynamic_update_slice(split_t, sp.do_split, (base,))
+            # Route rows of split nodes to their children; others park.
+            k = jnp.maximum(node_local, 0)
+            splits_here = (node_local >= 0) & sp.do_split[k]
+            go_left = binned[rows, sp.feature[k]] <= sp.boundary[k]
+            child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+            node = jnp.where(splits_here, child, node)
+        # Newton leaf values over final row positions
+        num = jax.ops.segment_sum(g, node, num_segments=NN)
+        den = jax.ops.segment_sum(h, node, num_segments=NN)
+        val_t = jnp.where(jnp.abs(den) < _NEWTON_DEN_GUARD, 0.0, num / jnp.maximum(den, _NEWTON_DEN_GUARD))
+        return feat_t, thr_t, val_t, split_t, node
+
+    def stage(t, carry):
+        raw, feats, thrs, vals, splits, devs = carry
+        p = jax.scipy.special.expit(raw)
+        g = yf - p          # residual (negative gradient of deviance)
+        h = p * (1.0 - p)   # Newton denominator terms
+        feat_t, thr_t, val_t, split_t, node = grow_tree(g, h)
+        raw = raw + learning_rate * val_t[node]
+        dev = -2.0 * jnp.mean(yf * raw - jnp.logaddexp(0.0, raw))
+        return (
+            raw,
+            feats.at[t].set(feat_t),
+            thrs.at[t].set(thr_t),
+            vals.at[t].set(val_t),
+            splits.at[t].set(split_t),
+            devs.at[t].set(dev),
+        )
+
+    init = (
+        jnp.full(n, f0, dtype),
+        jnp.zeros((n_stages, NN), jnp.int32),
+        jnp.full((n_stages, NN), jnp.inf, dtype),
+        jnp.zeros((n_stages, NN), dtype),
+        jnp.zeros((n_stages, NN), bool),
+        jnp.zeros(n_stages, dtype),
+    )
+    _, feats, thrs, vals, splits, devs = jax.lax.fori_loop(
+        0, n_stages, stage, init
+    )
+    return feats, thrs, vals, splits, devs

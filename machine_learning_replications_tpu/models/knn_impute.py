@@ -1,0 +1,62 @@
+"""1-nearest-neighbor imputation of missing clinical values.
+
+Reference: ``KNNImputer(missing_values=nan, n_neighbors=1, copy=True)`` fit
+on the development cohort and applied to both cohorts
+(``train_ensemble_public.py:37-40``). sklearn semantics replicated:
+
+  * distances are ``nan_euclidean`` — squared distance over mutually present
+    coordinates, rescaled by F / n_present (``ops.linalg.masked_pairwise_sq_dists``,
+    one masked-matmul triple on the MXU instead of sklearn's Cython loops);
+  * a donor for feature f must have f present;
+  * with no eligible donor (or all-NaN distance) the fit-column mean is used;
+  * n_neighbors=1 ⇒ the value of the single nearest donor.
+
+Functional API: ``fit`` captures the donor matrix; ``transform`` is pure and
+jittable (static feature count drives an unrolled per-feature argmin).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from machine_learning_replications_tpu.ops.linalg import masked_pairwise_sq_dists
+
+
+@flax.struct.dataclass
+class KNNImputerParams:
+    donors: jnp.ndarray     # [n_fit, F] — the fit cohort, NaNs included
+    col_means: jnp.ndarray  # [F] — nan-mean fallback per column
+
+
+def fit(X_fit: jnp.ndarray) -> KNNImputerParams:
+    X_fit = jnp.asarray(X_fit)
+    return KNNImputerParams(
+        donors=X_fit, col_means=jnp.nanmean(X_fit, axis=0)
+    )
+
+
+@jax.jit
+def transform(params: KNNImputerParams, X: jnp.ndarray) -> jnp.ndarray:
+    """Impute every NaN in ``X[nq, F]`` from the nearest eligible donor."""
+    X = jnp.asarray(X)
+    D = masked_pairwise_sq_dists(X, params.donors)      # [nq, n_fit]
+    D = jnp.where(jnp.isnan(D), jnp.inf, D)
+    donor_has = ~jnp.isnan(params.donors)                # [n_fit, F]
+    out_cols = []
+    for f in range(X.shape[1]):  # static F: one argmin pass per feature
+        Df = jnp.where(donor_has[:, f][None, :], D, jnp.inf)
+        idx = jnp.argmin(Df, axis=1)                     # [nq] nearest donor
+        has_any = jnp.isfinite(jnp.min(Df, axis=1))
+        donated = jnp.where(
+            has_any, params.donors[idx, f], params.col_means[f]
+        )
+        col = X[:, f]
+        out_cols.append(jnp.where(jnp.isnan(col), donated, col))
+    return jnp.stack(out_cols, axis=1)
+
+
+def fit_transform(X_fit: jnp.ndarray) -> tuple[KNNImputerParams, jnp.ndarray]:
+    params = fit(X_fit)
+    return params, transform(params, X_fit)

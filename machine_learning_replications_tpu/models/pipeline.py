@@ -1,0 +1,148 @@
+"""End-to-end training orchestration — the reference's whole program.
+
+``train_ensemble_public.py`` __main__ (SURVEY.md §3.1): load → KNN-impute →
+LassoCV-select 17 of 64 → fit the stacking ensemble → evaluate. This module
+is that pipeline as explicit functional stages over parameter pytrees.
+
+Stacking fit replicates ``StackingClassifier.fit`` (SURVEY.md §3.2): each
+base member is fitted once on the full data (those become the predict-time
+members), and 5-fold stratified ``cross_val_predict`` produces out-of-fold
+P(class 1) meta-features on which the final LR is trained. Fold fits
+currently run as a host-side loop with per-fold row subsets (two compiled
+shapes — fold sizes differ by ≤1 row); inside each SVC fit, the Platt CV
+sub-solves are vmapped. Fully vmapping the member-level fan-out is tracked
+as a TPU optimization, not done here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+
+from machine_learning_replications_tpu.config import ExperimentConfig
+from machine_learning_replications_tpu.models import (
+    feature_selection,
+    gbdt,
+    knn_impute,
+    linear,
+    scaler,
+    solvers,
+    stacking,
+    svm,
+    tree,
+)
+from machine_learning_replications_tpu.utils.cv import stratified_kfold_test_masks
+
+
+@flax.struct.dataclass
+class PipelineParams:
+    """Everything needed to go from a raw 64-feature row to a probability."""
+
+    imputer: knn_impute.KNNImputerParams
+    support_mask: jnp.ndarray  # [64] bool — Lasso-selected features
+    ensemble: stacking.StackingParams
+
+
+def fit_stacking(
+    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig = ExperimentConfig()
+) -> stacking.StackingParams:
+    """Fit the stacking ensemble on (already imputed + selected) ``X[n, 17]``."""
+    Xj = jnp.asarray(X)
+    yj = jnp.asarray(y)
+
+    # --- full-data member fits (the predict-time estimators_) -------------
+    scaler_p = scaler.fit(Xj)
+    svc_p = svm.svc_fit(
+        scaler.transform(scaler_p, Xj),
+        yj,
+        C=cfg.svc.C,
+        gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
+        balanced=cfg.svc.class_weight == "balanced",
+        probability=cfg.svc.probability,
+        platt_cv=cfg.svc.platt_cv,
+    )
+    gbdt_p, _ = gbdt.fit(np.asarray(X), np.asarray(y), cfg.gbdt)
+    lg_p = solvers.logreg_l1_fit(
+        Xj, yj, C=cfg.logreg.C, balanced=cfg.logreg.class_weight == "balanced"
+    )
+
+    # --- cross_val_predict meta-features ----------------------------------
+    meta_X = cross_val_member_probas(X, y, cfg)
+
+    meta_p = solvers.logreg_l2_fit(jnp.asarray(meta_X), yj, C=cfg.meta.C)
+
+    return stacking.StackingParams(
+        scaler=scaler_p, svc=svc_p, gbdt=gbdt_p, logreg=lg_p, meta=meta_p
+    )
+
+
+def cross_val_member_probas(
+    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig
+) -> np.ndarray:
+    """Out-of-fold P(class 1) per member — the ``[n, 3]`` meta-feature matrix
+    (sklearn: ``cross_val_predict(est, X, y, cv=5, method='predict_proba')``
+    per member, first column dropped)."""
+    X = np.asarray(X)
+    y = np.asarray(y)
+    n = X.shape[0]
+    test_masks = stratified_kfold_test_masks(y, cfg.stacking.cv_folds)
+    meta = np.zeros((n, 3))
+    for tm in test_masks:
+        tr = tm < 0.5
+        te = ~tr
+        Xtr, ytr, Xte = X[tr], y[tr], X[te]
+        # svc pipeline (scaler refit per fold, as sklearn clones the Pipeline)
+        sp = scaler.fit(jnp.asarray(Xtr))
+        vp = svm.svc_fit(
+            scaler.transform(sp, jnp.asarray(Xtr)),
+            jnp.asarray(ytr),
+            C=cfg.svc.C,
+            gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
+            balanced=cfg.svc.class_weight == "balanced",
+            probability=True,
+            platt_cv=cfg.svc.platt_cv,
+        )
+        meta[te, 0] = np.asarray(
+            svm.predict_proba1(vp, scaler.transform(sp, jnp.asarray(Xte)))
+        )
+        # gbdt
+        gp, _ = gbdt.fit(Xtr, ytr, cfg.gbdt)
+        meta[te, 1] = np.asarray(tree.predict_proba1(gp, jnp.asarray(Xte)))
+        # l1 logreg
+        lp = solvers.logreg_l1_fit(
+            jnp.asarray(Xtr), jnp.asarray(ytr), C=cfg.logreg.C,
+            balanced=cfg.logreg.class_weight == "balanced",
+        )
+        meta[te, 2] = np.asarray(linear.predict_proba1(lp, jnp.asarray(Xte)))
+    return meta
+
+
+def fit_pipeline(
+    X64: np.ndarray, y: np.ndarray, cfg: ExperimentConfig = ExperimentConfig()
+) -> tuple[PipelineParams, dict[str, Any]]:
+    """The full reference program: impute → select → stack.
+
+    ``X64`` is the raw 64-variable cohort (NaNs allowed); returns fitted
+    params plus selection diagnostics.
+    """
+    imp_p, X_imp = knn_impute.fit_transform(jnp.asarray(X64))
+    X_imp = np.asarray(X_imp)
+    mask, info = feature_selection.fit_select(X_imp, y, cfg.select)
+    ens = fit_stacking(X_imp[:, mask], y, cfg)
+    return (
+        PipelineParams(
+            imputer=imp_p, support_mask=jnp.asarray(mask), ensemble=ens
+        ),
+        {"selection": info, "n_selected": int(mask.sum())},
+    )
+
+
+def pipeline_predict_proba1(params: PipelineParams, X64: np.ndarray) -> jnp.ndarray:
+    """Raw 64-feature rows (NaNs allowed) → stacked P(class 1)."""
+    X_imp = knn_impute.transform(params.imputer, jnp.asarray(X64))
+    mask = np.asarray(params.support_mask)
+    X17 = X_imp[:, np.where(mask)[0]]
+    return stacking.predict_proba1(params.ensemble, X17)

@@ -1,0 +1,257 @@
+"""Convex solvers for the linear members — pure JAX, fixed-shape iterations.
+
+The reference reaches three native optimizers (SURVEY.md §2.4): Cython
+coordinate descent (LassoCV, ``train_ensemble_public.py:51``), liblinear's
+newGLMNET (L1 logistic regression, ``:46``), and lbfgs (meta learner,
+``:48``). All three problems are convex with (essentially) unique optima, so
+the TPU build solves the *same objectives* with accelerated proximal
+gradient (FISTA) and damped Newton — solver families chosen for the
+hardware: constant-shape dense matvecs, no data-dependent control flow,
+fold/alpha fan-out via ``vmap``/``scan``. Parity is at the optimum, not the
+iterate path (SURVEY.md §7 "rely on convexity").
+
+Objectives replicated exactly:
+  * Lasso:    1/(2n)·Σ w_i(y_i − x_i·β)² + α‖β‖₁           (sklearn Lasso)
+  * L1-LR:    ‖β̃‖₁ + C·Σ cw_i log(1+exp(−ỹ_i x̃_i·β̃))      (liblinear, which
+              *does* penalize the intercept via the appended bias column —
+              hence the shipped model's exactly-zero intercept)
+  * L2-LR:    ½‖β‖² + C·Σ cw_i log(1+exp(−ỹ_i(x_i·β + b)))  (lbfgs; intercept
+              unpenalized)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import expit
+
+from machine_learning_replications_tpu.models.linear import LinearParams
+
+
+def soft_threshold(x: jnp.ndarray, t) -> jnp.ndarray:
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+def balanced_class_weights(y: jnp.ndarray) -> jnp.ndarray:
+    """sklearn's ``class_weight='balanced'``: w_i = n / (2 · n_{class(i)})."""
+    n = y.shape[0]
+    n1 = jnp.sum(y)
+    n0 = n - n1
+    return jnp.where(y > 0.5, n / (2.0 * n1), n / (2.0 * n0))
+
+
+def _power_lmax(G: jnp.ndarray, iters: int = 30) -> jnp.ndarray:
+    """Largest eigenvalue of a PSD matrix by power iteration."""
+    v = jnp.ones(G.shape[0], G.dtype) / jnp.sqrt(G.shape[0])
+
+    def body(_, v):
+        w = G @ v
+        return w / jnp.maximum(jnp.linalg.norm(w), 1e-30)
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    return v @ (G @ v)
+
+
+# ---------------------------------------------------------------------------
+# Lasso (weighted, for masked CV folds)
+# ---------------------------------------------------------------------------
+
+
+def lasso_fista(
+    X: jnp.ndarray,           # [n, F] raw (uncentered)
+    y: jnp.ndarray,           # [n]
+    alpha,
+    sample_mask: jnp.ndarray, # [n] 1.0 = in this fit
+    w0: jnp.ndarray,
+    lmax,                     # λmax of (X_cᵀ diag(mask) X_c)/n_eff, precomputed
+    n_iter: int = 250,
+) -> jnp.ndarray:
+    """Weighted-row Lasso coefficients (no intercept — caller centers).
+
+    Centering under a row mask happens here so CV folds of different sizes
+    share one fixed-shape solver (SURVEY.md §7: padded folds with masked
+    reductions).
+    """
+    n_eff = jnp.sum(sample_mask)
+    xm = (sample_mask @ X) / n_eff
+    ym = (sample_mask @ y) / n_eff
+    Xc = (X - xm) * sample_mask[:, None]
+    yc = (y - ym) * sample_mask
+
+    step = 1.0 / jnp.maximum(lmax, 1e-12)
+
+    def body(_, state):
+        w, z, tk = state
+        grad = (Xc.T @ (Xc @ z - yc)) / n_eff
+        w_new = soft_threshold(z - step * grad, step * alpha)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
+        z = w_new + ((tk - 1.0) / t_new) * (w_new - w)
+        return w_new, z, t_new
+
+    w, _, _ = jax.lax.fori_loop(0, n_iter, body, (w0, w0, jnp.asarray(1.0, X.dtype)))
+    return w
+
+
+def lasso_intercept(X, y, w, sample_mask):
+    n_eff = jnp.sum(sample_mask)
+    return (sample_mask @ y) / n_eff - ((sample_mask @ X) / n_eff) @ w
+
+
+def alpha_grid(X: jnp.ndarray, y: jnp.ndarray, n_alphas: int, eps: float) -> jnp.ndarray:
+    """sklearn ``_alpha_grid``: α_max = max|X_cᵀ y_c|/n on the *full* centered
+    data; log-spaced down to ``eps·α_max``, descending."""
+    n = X.shape[0]
+    Xc = X - jnp.mean(X, axis=0)
+    yc = y - jnp.mean(y)
+    amax = jnp.max(jnp.abs(Xc.T @ yc)) / n
+    return jnp.logspace(0.0, jnp.log10(eps), n_alphas) * amax
+
+
+def lasso_path(
+    X, y, alphas, sample_mask, n_iter: int = 250
+) -> jnp.ndarray:
+    """Warm-started path over a descending alpha grid → coefs ``[A, F]``."""
+    n_eff = jnp.sum(sample_mask)
+    xm = (sample_mask @ X) / n_eff
+    Xc = (X - xm) * sample_mask[:, None]
+    lmax = _power_lmax(Xc.T @ Xc) / n_eff
+
+    def step(w, alpha):
+        w = lasso_fista(X, y, alpha, sample_mask, w, lmax, n_iter)
+        return w, w
+
+    w0 = jnp.zeros(X.shape[1], X.dtype)
+    _, coefs = jax.lax.scan(step, w0, alphas)
+    return coefs
+
+
+@functools.partial(jax.jit, static_argnames=("cv_folds", "n_alphas", "n_iter"))
+def lasso_cv(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    *,
+    cv_folds: int = 10,
+    n_alphas: int = 100,
+    eps: float = 1e-3,
+    n_iter: int = 250,
+):
+    """LassoCV (reference ``train_ensemble_public.py:51``): contiguous
+    unshuffled K-folds, shared full-data alpha grid, per-fold held-out MSE,
+    best alpha by mean MSE, final refit on all rows.
+
+    Returns ``(coef [F], intercept, alpha_, alphas [A], mse_path [A, K])``.
+    """
+    n = X.shape[0]
+    alphas = alpha_grid(X, y, n_alphas, eps)
+
+    # sklearn KFold(shuffle=False): first n % k folds get one extra row.
+    sizes = jnp.full(cv_folds, n // cv_folds) + (jnp.arange(cv_folds) < n % cv_folds)
+    starts = jnp.concatenate([jnp.zeros(1, sizes.dtype), jnp.cumsum(sizes)[:-1]])
+    idx = jnp.arange(n)
+    test_masks = (
+        (idx[None, :] >= starts[:, None]) & (idx[None, :] < (starts + sizes)[:, None])
+    ).astype(X.dtype)
+    train_masks = 1.0 - test_masks
+
+    def fold_mse(train_mask, test_mask):
+        coefs = lasso_path(X, y, alphas, train_mask, n_iter)  # [A, F]
+        intercepts = jax.vmap(lambda w: lasso_intercept(X, y, w, train_mask))(coefs)
+        preds = X @ coefs.T + intercepts[None, :]             # [n, A]
+        err2 = (preds - y[:, None]) ** 2 * test_mask[:, None]
+        return jnp.sum(err2, axis=0) / jnp.sum(test_mask)      # [A]
+
+    mse_path = jax.vmap(fold_mse)(train_masks, test_masks).T   # [A, K]
+    best = jnp.argmin(jnp.mean(mse_path, axis=1))
+    alpha_ = alphas[best]
+
+    full_mask = jnp.ones(n, X.dtype)
+    Xc = X - jnp.mean(X, axis=0)
+    lmax = _power_lmax(Xc.T @ Xc) / n
+    coef = lasso_fista(
+        X, y, alpha_, full_mask, jnp.zeros(X.shape[1], X.dtype), lmax, 2 * n_iter
+    )
+    intercept = lasso_intercept(X, y, coef, full_mask)
+    return coef, intercept, alpha_, alphas, mse_path
+
+
+# ---------------------------------------------------------------------------
+# Logistic regressions
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("balanced", "n_iter"))
+def logreg_l1_fit(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    C: float = 1.0,
+    sample_mask: jnp.ndarray | None = None,
+    balanced: bool = True,
+    n_iter: int = 1500,
+) -> LinearParams:
+    """liblinear-equivalent L1 logistic regression (bias column penalized)."""
+    n, F = X.shape
+    mask = jnp.ones(n, X.dtype) if sample_mask is None else sample_mask
+    cw = balanced_class_weights_masked(y, mask) if balanced else jnp.ones(n, X.dtype)
+    cw = cw * mask
+    Xt = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)  # bias column
+    s = 2.0 * y - 1.0  # ±1 labels
+
+    G = Xt.T @ (Xt * (C * cw)[:, None])
+    lmax = 0.25 * _power_lmax(G)
+    step = 1.0 / jnp.maximum(lmax, 1e-12)
+
+    def grad_fn(w):
+        m = s * (Xt @ w)
+        sig = expit(-m)  # d/dm log(1+e^{-m}) = -σ(-m)
+        return Xt.T @ (-(C * cw) * sig * s)
+
+    def body(_, state):
+        w, z, tk = state
+        w_new = soft_threshold(z - step * grad_fn(z), step)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
+        z = w_new + ((tk - 1.0) / t_new) * (w_new - w)
+        return w_new, z, t_new
+
+    w0 = jnp.zeros(F + 1, X.dtype)
+    w, _, _ = jax.lax.fori_loop(0, n_iter, body, (w0, w0, jnp.asarray(1.0, X.dtype)))
+    return LinearParams(coef=w[:F], intercept=w[F])
+
+
+def balanced_class_weights_masked(y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    n = jnp.sum(mask)
+    n1 = jnp.sum(y * mask)
+    n0 = n - n1
+    return jnp.where(y > 0.5, n / (2.0 * n1), n / (2.0 * n0))
+
+
+@functools.partial(jax.jit, static_argnames=("balanced", "n_iter"))
+def logreg_l2_fit(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    C: float = 1.0,
+    sample_mask: jnp.ndarray | None = None,
+    balanced: bool = True,
+    n_iter: int = 60,
+) -> LinearParams:
+    """lbfgs-equivalent L2 logistic regression via damped Newton
+    (dimensions here are tiny — 3 meta-features + intercept)."""
+    n, F = X.shape
+    mask = jnp.ones(n, X.dtype) if sample_mask is None else sample_mask
+    cw = (balanced_class_weights_masked(y, mask) if balanced else jnp.ones(n, X.dtype)) * mask
+    Xt = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
+    s = 2.0 * y - 1.0
+    reg = jnp.concatenate([jnp.ones(F, X.dtype), jnp.zeros(1, X.dtype)])  # no bias penalty
+
+    def body(_, w):
+        m = s * (Xt @ w)
+        sig = expit(-m)
+        grad = Xt.T @ (-(C * cw) * sig * s) + reg * w
+        D = (C * cw) * sig * (1.0 - sig)
+        H = Xt.T @ (Xt * D[:, None]) + jnp.diag(reg)
+        H = H + 1e-12 * jnp.eye(F + 1, dtype=X.dtype)
+        return w - jnp.linalg.solve(H, grad)
+
+    w = jax.lax.fori_loop(0, n_iter, body, jnp.zeros(F + 1, X.dtype))
+    return LinearParams(coef=w[:F], intercept=w[F])

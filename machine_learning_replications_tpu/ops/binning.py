@@ -1,0 +1,73 @@
+"""Feature quantization for histogram-based tree growing.
+
+sklearn's ``BestSplitter`` (Cython, reached via ``GradientBoostingClassifier``
+at ``train_ensemble_public.py:45``) enumerates *exact* sorted thresholds per
+node. The TPU-native replacement quantizes each feature once, up-front, into
+at most ``n_bins`` ordered bins; split search then scans bin boundaries
+(``ops.histogram``). Two regimes, one representation:
+
+  * ``n_unique <= n_bins`` — bins are the unique values themselves and the
+    candidate thresholds are the midpoints between adjacent unique values,
+    which is **bit-identical to sklearn's exact enumeration**. The HF
+    cohort's 17 features are mostly binary (SURVEY.md §7 "Hard parts"), so
+    the reference workload always runs in this exact regime.
+  * ``n_unique > n_bins`` — quantile-spaced subset of the midpoints
+    (XGBoost/LightGBM-style approximate splitting) for the scaled configs.
+
+Binning is host-side numpy at ingest time (one pass, like a quantile
+sketch); training afterwards touches only the int32 bin matrix on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BinnedFeatures:
+    """Quantized design matrix + the threshold table to decode splits."""
+
+    binned: np.ndarray      # [n, F] int32 — bin index per value
+    thresholds: np.ndarray  # [F, n_bins-1] float64 — candidate split values,
+                            # +inf past the last real boundary of a feature
+    n_bins: np.ndarray      # [F] int32 — real bin count per feature
+
+    @property
+    def max_bins(self) -> int:
+        return self.thresholds.shape[1] + 1
+
+
+def bin_features(X: np.ndarray, n_bins: int = 256) -> BinnedFeatures:
+    """Quantize ``X[n, F]`` column-wise into at most ``n_bins`` bins.
+
+    A value lands in bin ``b`` = number of thresholds strictly below it;
+    "split at boundary b" then means "go left iff bin <= b", and the
+    real-valued threshold stored in the fitted tree is ``thresholds[f, b]``
+    (a midpoint, matching sklearn's ``(v_i + v_{i+1})/2``).
+    """
+    n, F = X.shape
+    thresholds = np.full((F, n_bins - 1), np.inf)
+    counts = np.ones(F, np.int32)
+    binned = np.zeros((n, F), np.int32)
+    for f in range(F):
+        u = np.unique(X[:, f])  # sorted, NaN would sort last — reject it
+        if np.isnan(u).any():
+            raise ValueError(f"feature {f} contains NaN; impute before binning")
+        if u.size > n_bins:
+            # Quantile-spaced representative subset (keep extremes).
+            q = np.linspace(0, 1, n_bins)
+            idx = np.unique((q * (u.size - 1)).round().astype(int))
+            u = u[idx]
+        mids = (u[:-1] + u[1:]) / 2.0
+        # sklearn guard (BestSplitter): if the midpoint rounds up to the upper
+        # value, use the lower value as the threshold so the upper sample
+        # still routes right under "x <= t goes left".
+        mids = np.where(mids == u[1:], u[:-1], mids)
+        thresholds[f, : mids.size] = mids
+        counts[f] = u.size
+        # bin(v) = #{mids < v}, except v exactly equal to a midpoint stays in
+        # the left bin — searchsorted(side='left') gives precisely that.
+        binned[:, f] = np.searchsorted(mids, X[:, f], side="left")
+    return BinnedFeatures(binned=binned, thresholds=thresholds, n_bins=counts)

@@ -1,0 +1,207 @@
+"""Histogram construction and split search — the hot op of GBDT training.
+
+Replaces sklearn's Cython ``DepthFirstTreeBuilder``/``BestSplitter``
+(SURVEY.md §2.4) with vectorized, branch-free device code:
+
+  * ``node_histograms`` — per-(node, feature, bin) sums of gradient,
+    hessian-proxy, squared gradient and counts, via one flattened
+    ``segment_sum`` (XLA lowers this to scatter-adds; under ``pjit`` with
+    rows sharded on 'data' the partials combine with an all-reduce; a
+    Pallas kernel backend accumulates in VMEM instead).
+  * ``best_splits`` — friedman-MSE split selection over cumulative
+    histograms, matching sklearn's proxy ``diff² · wL · wR`` ordering and
+    its leaf conditions (node variance ≤ eps, n < min_samples_split).
+
+All shapes are static: K nodes × F features × B bins.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# sklearn's impurity-is-zero leaf test: impurity <= EPSILON (np.finfo(double).eps)
+_IMPURITY_EPS = 2.220446049250313e-16
+
+
+class StumpData(NamedTuple):
+    """Replicated-sorted-layout training set for depth-1 boosting.
+
+    TPU hates scatters *and* gathers (both serialize onto the scalar unit),
+    but the bin matrix never changes across boosting stages — so we pay
+    memory instead of memory traffic: hold the label/score vectors in **F
+    copies, each pre-sorted by one feature's bins**. Every stage is then
+    pure dense work — elementwise math on ``[F, n]``, a cumsum, and *static*
+    boundary lookups — with F-fold redundant flops (trivial) and zero
+    dynamic indexing. ``bins_x`` carries every feature's bins in every sort
+    order so split routing is a dense compare too.
+
+    Under ``shard_map`` each data shard builds this structure from its local
+    rows; per-shard cumulative sums combine with one tiny ``psum`` of
+    ``[F, B-1]`` per stage (SURVEY.md §2.5: histogram partials over ICI).
+    """
+
+    bins_x: jnp.ndarray      # [F_query, F_sort, n] uint8 — bins of feature
+                             #   f_q for rows in f_s's sorted order
+    y_sorted: jnp.ndarray    # [F, n] — labels in each sort order
+    left_count: jnp.ndarray  # [F, B-1] int — #rows with bin ≤ b (static CL)
+    thresholds: jnp.ndarray  # [F, B-1] — real-valued candidate thresholds
+
+
+def build_stump_data(bins, y, dtype=None) -> StumpData:
+    """Host-side precompute (numpy, once per dataset) from BinnedFeatures."""
+    import numpy as np
+
+    b = np.asarray(bins.binned)
+    n, F = b.shape
+    if bins.max_bins > 256:
+        raise ValueError("stump fast path stores bins as uint8 (max 256 bins)")
+    order = np.argsort(b, axis=0, kind="stable")  # [n, F] — rows by each feature
+    bins_x = np.empty((F, F, n), np.uint8)
+    y_sorted = np.empty((F, n), np.asarray(y).dtype)
+    for fs in range(F):
+        bins_x[:, fs, :] = b[order[:, fs], :].T
+        y_sorted[fs] = np.asarray(y)[order[:, fs]]
+    counts = np.stack(
+        [np.bincount(b[:, f], minlength=bins.max_bins) for f in range(F)]
+    )
+    left_count = np.cumsum(counts, axis=1)[:, :-1]
+    thresholds = jnp.asarray(bins.thresholds)
+    ys = jnp.asarray(y_sorted)
+    if dtype is not None:
+        thresholds = thresholds.astype(dtype)
+        ys = ys.astype(dtype)
+    return StumpData(
+        bins_x=jnp.asarray(bins_x),
+        y_sorted=ys,
+        left_count=jnp.asarray(left_count.astype(np.int32)),
+        thresholds=thresholds,
+    )
+
+
+def cumulative_boundary_sums(
+    v_sorted: jnp.ndarray, left_count: jnp.ndarray
+) -> jnp.ndarray:
+    """``out[f, b] = Σ v over rows with bin[f] ≤ b`` from per-feature-sorted
+    values: one cumsum + one static lookup. ``v_sorted`` is ``[F, n]``."""
+    csum = jnp.cumsum(v_sorted, axis=1)
+    padded = jnp.concatenate(
+        [jnp.zeros((csum.shape[0], 1), csum.dtype), csum], axis=1
+    )
+    return jnp.take_along_axis(padded, left_count, axis=1)
+
+
+class NodeHistograms(NamedTuple):
+    grad: jnp.ndarray   # [K, F, B] Σ residual
+    hess: jnp.ndarray   # [K, F, B] Σ p(1−p)  (Newton denominator terms)
+    grad2: jnp.ndarray  # [K, F, B] Σ residual² (for the impurity leaf test)
+    count: jnp.ndarray  # [K, F, B] sample counts
+
+
+class Splits(NamedTuple):
+    do_split: jnp.ndarray   # [K] bool — node splits (vs becomes/stays a leaf)
+    feature: jnp.ndarray    # [K] int32
+    boundary: jnp.ndarray   # [K] int32 — bin boundary b (left ⇔ bin ≤ b)
+    threshold: jnp.ndarray  # [K] float — real-valued split threshold
+    gain: jnp.ndarray       # [K] float — friedman proxy of the chosen split
+
+
+def node_histograms(
+    binned: jnp.ndarray,      # [n, F] int32
+    node_local: jnp.ndarray,  # [n] int32 — local node index, −1 ⇒ inactive row
+    grad: jnp.ndarray,        # [n]
+    hess: jnp.ndarray,        # [n]
+    n_nodes: int,
+    max_bins: int,
+) -> NodeHistograms:
+    """One `segment_sum` over n·F (node, feature, bin) cells.
+
+    Inactive rows (parked at an ancestor leaf, or padding) go to a dump
+    segment past the real range.
+    """
+    n, F = binned.shape
+    B = max_bins
+    f_idx = jnp.arange(F, dtype=jnp.int32)
+    seg = (node_local[:, None] * F + f_idx[None, :]) * B + binned  # [n, F]
+    seg = jnp.where(node_local[:, None] >= 0, seg, n_nodes * F * B)
+    seg = seg.reshape(-1)
+    num_segments = n_nodes * F * B + 1
+
+    def acc(v):
+        flat = jnp.broadcast_to(v[:, None], (n, F)).reshape(-1)
+        s = jax.ops.segment_sum(flat, seg, num_segments=num_segments)
+        return s[:-1].reshape(n_nodes, F, B)
+
+    ones = jnp.ones_like(grad)
+    return NodeHistograms(
+        grad=acc(grad), hess=acc(hess), grad2=acc(grad * grad), count=acc(ones)
+    )
+
+
+def select_splits(
+    GL: jnp.ndarray,          # [K, F, B-1] left-of-boundary residual sums
+    CL: jnp.ndarray,          # [K, F, B-1] left-of-boundary counts
+    GT: jnp.ndarray,          # [K] node residual sums
+    CT: jnp.ndarray,          # [K] node counts
+    sum_g2: jnp.ndarray,      # [K] node Σ residual² (impurity leaf test)
+    thresholds: jnp.ndarray,  # [F, B-1] — +inf past a feature's last boundary
+    min_samples_split: int = 2,
+    min_samples_leaf: int = 1,
+) -> Splits:
+    """sklearn-equivalent friedman_mse split selection from cumulative sums.
+
+    A node becomes a leaf when its residual variance is ≤ machine eps
+    (sklearn's pure-node test), it has fewer than ``min_samples_split``
+    samples, or no boundary leaves ≥ ``min_samples_leaf`` on both sides.
+    Ties in gain resolve to the first (feature, boundary) in flat order
+    (sklearn breaks ties by a seeded feature permutation — immaterial for
+    metric-level parity, noted per SURVEY.md §7).
+    """
+    GR = GT[:, None, None] - GL
+    CR = CT[:, None, None] - CL
+
+    valid = (
+        (CL >= min_samples_leaf)
+        & (CR >= min_samples_leaf)
+        & jnp.isfinite(thresholds)[None, :, :]
+    )
+    diff = GL / jnp.maximum(CL, 1) - GR / jnp.maximum(CR, 1)
+    proxy = diff * diff * CL * CR  # friedman proxy; CT constant per node
+    proxy = jnp.where(valid, proxy, -jnp.inf)
+
+    K, F, Bm1 = proxy.shape
+    flat = proxy.reshape(K, F * Bm1)
+    best = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
+    f = best // Bm1
+    b = best % Bm1
+    thr = thresholds[f, b]
+
+    # Node-level leaf tests (sklearn DepthFirstTreeBuilder)
+    mean = GT / jnp.maximum(CT, 1)
+    impurity = jnp.maximum(sum_g2 / jnp.maximum(CT, 1) - mean * mean, 0.0)
+    do_split = (
+        (CT >= min_samples_split)
+        & (impurity > _IMPURITY_EPS)
+        & jnp.isfinite(best_gain)
+    )
+    return Splits(do_split=do_split, feature=f, boundary=b, threshold=thr, gain=best_gain)
+
+
+def best_splits(
+    hists: NodeHistograms,
+    thresholds: jnp.ndarray,
+    min_samples_split: int = 2,
+    min_samples_leaf: int = 1,
+) -> Splits:
+    """Split selection from per-node histograms (the generic depth≥2 path)."""
+    GL = jnp.cumsum(hists.grad, axis=-1)[..., :-1]
+    CL = jnp.cumsum(hists.count, axis=-1)[..., :-1]
+    GT = jnp.sum(hists.grad, axis=-1)[:, 0]
+    CT = jnp.sum(hists.count, axis=-1)[:, 0]
+    sum_g2 = jnp.sum(hists.grad2, axis=-1)[:, 0]
+    return select_splits(
+        GL, CL, GT, CT, sum_g2, thresholds, min_samples_split, min_samples_leaf
+    )

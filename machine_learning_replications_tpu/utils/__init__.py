@@ -1,0 +1,8 @@
+"""Cross-cutting utilities: CV fold replication, profiling, logging."""
+
+from machine_learning_replications_tpu.utils.cv import (
+    kfold_test_masks,
+    stratified_kfold_test_masks,
+)
+
+__all__ = ["kfold_test_masks", "stratified_kfold_test_masks"]

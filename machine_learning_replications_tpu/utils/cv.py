@@ -1,0 +1,51 @@
+"""Deterministic replication of sklearn's unshuffled CV fold assignment.
+
+The reference's CV is fully deterministic despite the seeds it passes around:
+``LassoCV(cv=10)`` → ``KFold(10, shuffle=False)`` (contiguous blocks) and
+``StackingClassifier(cv=None)`` → ``StratifiedKFold(5, shuffle=False)``
+(per-class block assignment). Replicating the assignments exactly keeps
+fold-level parity with sklearn available to the differential tests
+(SURVEY.md §7 "RNG parity": fold assignment is feasible to replicate;
+in-solver RNG is not).
+
+Masks, not index lists: every fold shares one static shape so fold fits can
+``vmap`` (SURVEY.md §7 "fold-size padding with masked reductions").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kfold_test_masks(n: int, k: int) -> np.ndarray:
+    """``KFold(k, shuffle=False)``: contiguous blocks, first ``n % k`` folds
+    one row larger. Returns ``[k, n]`` float 0/1 test masks."""
+    sizes = np.full(k, n // k)
+    sizes[: n % k] += 1
+    masks = np.zeros((k, n))
+    start = 0
+    for i, sz in enumerate(sizes):
+        masks[i, start : start + sz] = 1.0
+        start += sz
+    return masks
+
+
+def stratified_kfold_test_masks(y: np.ndarray, k: int) -> np.ndarray:
+    """``StratifiedKFold(k, shuffle=False)`` exactly as sklearn assigns it:
+    for each class, its occurrences (in row order) are dealt into folds in
+    blocks sized by interleaving the sorted class sequence."""
+    y = np.asarray(y)
+    classes, y_enc = np.unique(y, return_inverse=True)
+    n_classes = classes.shape[0]
+    y_order = np.sort(y_enc)
+    allocation = np.asarray(
+        [np.bincount(y_order[i::k], minlength=n_classes) for i in range(k)]
+    )  # [k, n_classes]
+    test_folds = np.empty(y.shape[0], dtype=int)
+    for c in range(n_classes):
+        folds_for_class = np.arange(k).repeat(allocation[:, c])
+        test_folds[y_enc == c] = folds_for_class
+    masks = np.zeros((k, y.shape[0]))
+    for i in range(k):
+        masks[i, test_folds == i] = 1.0
+    return masks

@@ -1,0 +1,128 @@
+"""GBDT training parity vs sklearn's GradientBoostingClassifier.
+
+The exact-midpoint binning regime (n_unique ≤ n_bins) makes our histogram
+split search enumerate the same candidate set as sklearn's BestSplitter, so
+on generic data (no exact gain ties) the fitted forests should agree
+structurally — features, thresholds, leaf values — and numerically in the
+deviance path and predictions. SURVEY.md §4 "training-parity" tests.
+"""
+
+import numpy as np
+import pytest
+from sklearn.ensemble import GradientBoostingClassifier
+
+from machine_learning_replications_tpu.config import GBDTConfig
+from machine_learning_replications_tpu.models import gbdt, tree
+
+
+@pytest.fixture(scope="module")
+def train_data():
+    rng = np.random.default_rng(7)
+    n, f = 500, 17
+    X = rng.normal(size=(n, f))
+    X[:, :12] = (X[:, :12] > 0.4).astype(float)   # mostly binary, like the cohort
+    X[:, 12:] = np.round(X[:, 12:] * 8) / 2       # coarse-grained continuous
+    w = rng.normal(size=f)
+    y = (X @ w + 0.8 * rng.normal(size=n) > 0.3).astype(float)
+    return X, y
+
+
+@pytest.mark.parametrize("max_depth,n_estimators", [(1, 60), (2, 25)])
+def test_structural_and_numeric_parity(train_data, max_depth, n_estimators):
+    X, y = train_data
+    sk = GradientBoostingClassifier(
+        n_estimators=n_estimators, max_depth=max_depth, random_state=2020
+    ).fit(X, y)
+    params, aux = gbdt.fit(
+        X, y, GBDTConfig(n_estimators=n_estimators, max_depth=max_depth)
+    )
+
+    # Deviance trajectory — same −2·loglik definition as the 0.23 pickle
+    np.testing.assert_allclose(aux["train_deviance"], sk.train_score_, rtol=1e-9)
+
+    # Per-stage root split must match sklearn exactly
+    for t in range(n_estimators):
+        sk_tree = sk.estimators_[t, 0].tree_
+        assert int(params.feature[t, 0]) == int(sk_tree.feature[0]), f"stage {t}"
+        np.testing.assert_allclose(
+            float(params.threshold[t, 0]), float(sk_tree.threshold[0]), rtol=1e-12,
+            err_msg=f"stage {t}",
+        )
+
+    # Raw predictions identical ⇒ every leaf value/structure effect matches
+    rng = np.random.default_rng(1)
+    Xq = X[rng.permutation(len(X))[:200]]
+    np.testing.assert_allclose(
+        np.asarray(tree.raw_score(params, Xq)),
+        sk.decision_function(Xq),
+        rtol=1e-9,
+        atol=1e-10,
+    )
+
+
+def test_depth3_metric_parity(train_data):
+    """At depth 3 this dataset hits *exact* gain ties resolved differently
+    (sklearn uses a seeded feature permutation; we take first-in-order —
+    verified to be true ties, equal friedman proxies). Demand metric-level
+    parity instead of structural parity (SURVEY.md §7 'RNG parity')."""
+    from sklearn.metrics import roc_auc_score
+
+    X, y = train_data
+    sk = GradientBoostingClassifier(n_estimators=12, max_depth=3, random_state=2020).fit(X, y)
+    params, aux = gbdt.fit(X, y, GBDTConfig(n_estimators=12, max_depth=3))
+    np.testing.assert_allclose(aux["train_deviance"], sk.train_score_, rtol=0.03)
+    a_sk = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    a_us = roc_auc_score(y, np.asarray(tree.predict_proba1(params, X)))
+    assert abs(a_sk - a_us) < 0.005
+
+
+def test_stump_leaf_values_match(train_data):
+    X, y = train_data
+    sk = GradientBoostingClassifier(n_estimators=5, max_depth=1, random_state=2020).fit(X, y)
+    params, _ = gbdt.fit(X, y, GBDTConfig(n_estimators=5, max_depth=1))
+    for t in range(5):
+        sk_vals = np.sort(sk.estimators_[t, 0].tree_.value[1:3, 0, 0])
+        ours = np.sort(np.asarray(params.value[t, 1:3]))
+        np.testing.assert_allclose(ours, sk_vals, rtol=1e-9)
+
+
+def test_auc_parity(train_data):
+    from sklearn.metrics import roc_auc_score
+
+    X, y = train_data
+    rng = np.random.default_rng(9)
+    perm = rng.permutation(len(X))
+    tr, te = perm[:350], perm[350:]
+    sk = GradientBoostingClassifier(n_estimators=100, max_depth=1, random_state=2020).fit(
+        X[tr], y[tr]
+    )
+    params, _ = gbdt.fit(X[tr], y[tr], GBDTConfig(n_estimators=100, max_depth=1))
+    auc_sk = roc_auc_score(y[te], sk.predict_proba(X[te])[:, 1])
+    auc_tpu = roc_auc_score(y[te], np.asarray(tree.predict_proba1(params, X[te])))
+    assert abs(auc_sk - auc_tpu) < 0.005  # BASELINE.json parity budget
+
+
+def test_pure_node_becomes_leaf():
+    # Constant labels in a region: once residuals are uniform the node must
+    # not split (sklearn's impurity <= eps leaf test).
+    X = np.array([[0.0]] * 50 + [[1.0]] * 50)
+    y = np.array([0.0] * 50 + [1.0] * 50)
+    params, _ = gbdt.fit(X, y, GBDTConfig(n_estimators=3, max_depth=3))
+    sk = GradientBoostingClassifier(n_estimators=3, max_depth=3, random_state=0).fit(X, y)
+    np.testing.assert_allclose(
+        np.asarray(tree.raw_score(params, X)), sk.decision_function(X), rtol=1e-9
+    )
+
+
+def test_quantized_regime_close():
+    # >n_bins unique values: approximate splits; demand metric-level parity.
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2000, 5))
+    y = (X @ rng.normal(size=5) + rng.normal(size=2000) > 0).astype(float)
+    params, _ = gbdt.fit(X, y, GBDTConfig(n_estimators=40, max_depth=2, n_bins=64))
+    sk = GradientBoostingClassifier(n_estimators=40, max_depth=2, random_state=0).fit(X, y)
+    a1 = roc_auc_score(y, np.asarray(tree.predict_proba1(params, X)))
+    a2 = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    assert abs(a1 - a2) < 0.01

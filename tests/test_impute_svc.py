@@ -1,0 +1,120 @@
+"""KNN imputation, CV fold replication, and SVC training parity."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from machine_learning_replications_tpu.models import knn_impute, scaler, svm
+from machine_learning_replications_tpu.utils import (
+    kfold_test_masks,
+    stratified_kfold_test_masks,
+)
+
+
+def test_kfold_masks_match_sklearn():
+    from sklearn.model_selection import KFold
+
+    for n, k in [(1427, 10), (713, 5), (100, 7)]:
+        ours = kfold_test_masks(n, k)
+        for i, (_, test) in enumerate(KFold(k).split(np.zeros((n, 1)))):
+            np.testing.assert_array_equal(np.where(ours[i])[0], test)
+
+
+def test_stratified_kfold_masks_match_sklearn():
+    from sklearn.model_selection import StratifiedKFold
+
+    rng = np.random.default_rng(0)
+    for n, k in [(713, 5), (500, 5), (101, 3)]:
+        y = (rng.random(n) < 0.2).astype(float)
+        ours = stratified_kfold_test_masks(y, k)
+        for i, (_, test) in enumerate(StratifiedKFold(k).split(np.zeros((n, 1)), y)):
+            np.testing.assert_array_equal(np.where(ours[i])[0], test)
+
+
+def test_knn_impute_matches_sklearn(cohort):
+    from sklearn.impute import KNNImputer
+
+    X, _, _ = cohort  # has 5% MCAR missingness in non-binary columns
+    sk = KNNImputer(missing_values=np.nan, n_neighbors=1, copy=True)
+    X_sk = sk.fit_transform(X)
+    params, X_ours = knn_impute.fit_transform(jnp.asarray(X))
+    np.testing.assert_allclose(np.asarray(X_ours), X_sk, rtol=1e-12, atol=1e-12)
+
+
+def test_knn_impute_transform_other_cohort(cohort):
+    from sklearn.impute import KNNImputer
+    from machine_learning_replications_tpu.data import make_cohort
+
+    X, _, _ = cohort
+    X2, _, _ = make_cohort(n=200, seed=77, missing_rate=0.08)
+    sk = KNNImputer(n_neighbors=1).fit(X)
+    params = knn_impute.fit(jnp.asarray(X))
+    np.testing.assert_allclose(
+        np.asarray(knn_impute.transform(params, jnp.asarray(X2))),
+        sk.transform(X2),
+        rtol=1e-12,
+        atol=1e-12,
+    )
+
+
+@pytest.fixture(scope="module")
+def svc_data():
+    rng = np.random.default_rng(21)
+    n, f = 350, 17
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = (X @ w + 1.2 * rng.normal(size=n) > 0.8).astype(float)  # ~20% positive
+    return X, y
+
+
+def test_svc_fit_decision_parity(svc_data):
+    from sklearn.svm import SVC
+
+    X, y = svc_data
+    sp = scaler.fit(jnp.asarray(X))
+    Xt = scaler.transform(sp, jnp.asarray(X))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sk = SVC(class_weight="balanced", probability=True, random_state=2020).fit(
+            np.asarray(Xt), y
+        )
+    ours = svm.svc_fit(Xt, jnp.asarray(y), n_iter=4000)
+    np.testing.assert_allclose(float(ours.gamma), sk._gamma, rtol=1e-9)
+
+    dec_sk = sk.decision_function(np.asarray(Xt))
+    dec_us = np.asarray(svm.decision_function(ours, Xt))
+    # libsvm stops at KKT tol 1e-3; demand matching decisions to ~1e-3
+    assert np.abs(dec_sk - dec_us).max() < 5e-3, np.abs(dec_sk - dec_us).max()
+    np.testing.assert_allclose(float(ours.intercept), sk.intercept_[0], atol=5e-3)
+
+    # support vector pattern: nonzero coefs agree (up to boundary wobble)
+    sk_sv = np.zeros(len(y), bool)
+    sk_sv[sk.support_] = True
+    our_sv = np.abs(np.asarray(ours.dual_coef)) > 1e-6
+    assert (sk_sv ^ our_sv).mean() < 0.03
+
+    # Platt: same sign structure and close calibration
+    assert float(ours.prob_a) < 0
+    # probability predictions close at the metric level
+    p_sk = sk.predict_proba(np.asarray(Xt))[:, 1]
+    p_us = np.asarray(svm.predict_proba1(ours, Xt))
+    assert np.abs(p_sk - p_us).max() < 0.05
+    assert np.corrcoef(p_sk, p_us)[0, 1] > 0.999
+
+
+def test_trim_support(svc_data):
+    X, y = svc_data
+    sp = scaler.fit(jnp.asarray(X))
+    Xt = scaler.transform(sp, jnp.asarray(X))
+    full = svm.svc_fit(Xt, jnp.asarray(y), probability=False, n_iter=2000)
+    trimmed = svm.trim_support(full)
+    assert trimmed.support_vectors.shape[0] < Xt.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(svm.decision_function(trimmed, Xt)),
+        np.asarray(svm.decision_function(full, Xt)),
+        rtol=1e-9,
+        atol=1e-9,
+    )

@@ -1,0 +1,76 @@
+"""Full-pipeline differential test — the reference program end to end.
+
+Mirrors ``train_ensemble_public.py``: impute → select 17 → stacking fit on
+the development split, evaluate on the model-selection split; sklearn runs
+the same protocol on the same synthetic cohort and AUCs must agree within
+the BASELINE.json parity budget (±0.005).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.config import ExperimentConfig, GBDTConfig, LassoSelectConfig
+from machine_learning_replications_tpu.data.synthetic import dev_select_split
+from machine_learning_replications_tpu.models import pipeline
+
+
+def _sklearn_reference_pipeline(X_dev, y_dev, X_sel):
+    from sklearn.ensemble import GradientBoostingClassifier, StackingClassifier
+    from sklearn.feature_selection import SelectFromModel
+    from sklearn.impute import KNNImputer
+    from sklearn.linear_model import LassoCV, LogisticRegression
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    imputer = KNNImputer(missing_values=np.nan, n_neighbors=1, copy=True)
+    X_dev = imputer.fit_transform(X_dev)
+    X_sel = imputer.transform(X_sel)
+    lasso = LassoCV(random_state=2020, cv=10)
+    sfm = SelectFromModel(lasso, threshold=-np.inf, max_features=17).fit(X_dev, y_dev)
+    sup = sfm.get_support()
+    clf = StackingClassifier(
+        estimators=[
+            ("svc", make_pipeline(StandardScaler(), SVC(class_weight="balanced", probability=True, random_state=2020))),
+            ("gbc", GradientBoostingClassifier(n_estimators=100, max_depth=1, random_state=2020)),
+            ("lg", LogisticRegression(class_weight="balanced", penalty="l1", solver="liblinear")),
+        ],
+        final_estimator=LogisticRegression(class_weight="balanced"),
+    )
+    clf.fit(X_dev[:, sup], y_dev)
+    return clf.predict_proba(X_sel[:, sup])[:, 1], sup
+
+
+@pytest.mark.slow
+def test_full_pipeline_auc_parity(cohort_full):
+    from sklearn.metrics import roc_auc_score
+
+    X, y, _ = cohort_full
+    # add some missingness to exercise imputation
+    rng = np.random.default_rng(3)
+    Xm = X.copy()
+    miss = rng.random(X.shape) < 0.02
+    nonbin = np.std(X, axis=0) > 0.51  # rough: only continuous-ish cols
+    Xm[miss & nonbin[None, :]] = np.nan
+
+    X_dev, y_dev, X_sel, y_sel = dev_select_split(Xm, y, seed=2020)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        p_sk, sup_sk = _sklearn_reference_pipeline(X_dev, y_dev, X_sel)
+
+    params, info = pipeline.fit_pipeline(X_dev, y_dev, ExperimentConfig())
+    p_us = np.asarray(pipeline.pipeline_predict_proba1(params, X_sel))
+
+    assert info["n_selected"] == 17
+    # selected sets should agree (deterministic protocol both sides)
+    sup_us = np.asarray(params.support_mask)
+    assert (sup_us == sup_sk).mean() >= 62 / 64, (np.where(sup_us)[0], np.where(sup_sk)[0])
+
+    auc_sk = roc_auc_score(y_sel, p_sk)
+    auc_us = roc_auc_score(y_sel, p_us)
+    assert abs(auc_sk - auc_us) < 0.005, (auc_sk, auc_us)
+    # probabilities track closely, not just rank order
+    assert np.corrcoef(p_sk, p_us)[0, 1] > 0.99
