@@ -1,0 +1,318 @@
+"""Distributed depth-1 GBDT training — the sharded version of the
+replicated-sorted-layout trainer (``models.gbdt._fit_stumps``).
+
+Mesh mapping (SURVEY.md §2.5 — promoting the reference's implicit axes):
+
+  data  — cohort rows. Each shard holds its own rows in *locally* sorted
+          order per feature; cumulative left-of-boundary sums are additive
+          across shards, so the only per-stage communication is a ``psum``
+          of ``[F, B-1]`` gradient/hessian partials (plus five scalars) over
+          ICI. This is the "histogram partials all-reduced" design.
+  model — feature tiles of the split search: each shard owns the sorted
+          copies of F/model features and scores their candidate splits; the
+          global argmax is recovered with one tiny ``all_gather`` of
+          per-shard bests. Split routing needs the *chosen* feature's bins
+          in every local sort order, which is why ``bins_x`` keeps its
+          query-feature axis unsharded.
+
+The whole boosting loop lives inside one ``shard_map``-ped ``jit``; nothing
+crosses the host boundary per stage.
+
+Padding contracts: rows padded per shard carry weight 0 and bin ``B-1``
+(they sort past every candidate boundary, and all their sums are masked);
+features padded to a multiple of the model-axis size get +inf thresholds
+(never selectable).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from machine_learning_replications_tpu.config import GBDTConfig
+from machine_learning_replications_tpu.models import gbdt
+from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
+from machine_learning_replications_tpu.ops import binning
+from machine_learning_replications_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+_NEWTON_DEN_GUARD = 1e-150
+
+
+def _prepare_shards(
+    bins: binning.BinnedFeatures, y: np.ndarray, n_data: int, n_model: int
+):
+    """Host-side: partition rows into contiguous shards, locally sort each,
+    pad rows and features. Returns stacked arrays with leading shard axes."""
+    b = np.asarray(bins.binned)
+    n, F = b.shape
+    B = bins.max_bins
+    if B > 256:
+        raise ValueError("sharded stump trainer stores bins as uint8 (max 256 bins)")
+    F_pad = -(-F // n_model) * n_model
+    n_local = -(-n // n_data)
+
+    bins_x = np.full((n_data, F_pad, F_pad, n_local), B - 1, np.uint8)
+    y_sorted = np.zeros((n_data, F_pad, n_local), np.float32)
+    w_sorted = np.zeros((n_data, F_pad, n_local), np.float32)
+    left_count = np.zeros((n_data, F_pad, B - 1), np.int32)
+    thresholds = np.full((F_pad, B - 1), np.inf, np.float64)
+    thresholds[:F] = np.asarray(bins.thresholds)
+
+    for s in range(n_data):
+        rows = slice(s * n_local, min((s + 1) * n_local, n))
+        bl = b[rows]
+        yl = np.asarray(y)[rows]
+        k = bl.shape[0]
+        # pad rows: bin B-1 everywhere, weight 0
+        bl = np.concatenate([bl, np.full((n_local - k, F), B - 1, bl.dtype)])
+        yl = np.concatenate([yl, np.zeros(n_local - k)])
+        wl = np.concatenate([np.ones(k), np.zeros(n_local - k)])
+        order = np.argsort(bl, axis=0, kind="stable")  # [n_local, F]
+        for fs in range(F):
+            bins_x[s, :F, fs, :] = bl[order[:, fs], :].T
+            y_sorted[s, fs] = yl[order[:, fs]]
+            w_sorted[s, fs] = wl[order[:, fs]]
+            cnt = np.bincount(bl[:k, fs], minlength=B)
+            left_count[s, fs] = np.cumsum(cnt)[:-1]
+        # padded feature slots: rows unsorted, weights zero — inert
+        for fs in range(F, F_pad):
+            y_sorted[s, fs] = yl
+            w_sorted[s, fs] = wl
+    return bins_x, y_sorted, w_sorted, left_count, thresholds, F_pad, n_local
+
+
+def fit(
+    mesh: jax.sharding.Mesh,
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: GBDTConfig = GBDTConfig(),
+    bins: binning.BinnedFeatures | None = None,
+) -> tuple[TreeEnsembleParams, dict[str, Any]]:
+    """Depth-1 GBDT fit sharded over ``mesh`` (axes 'data' × 'model')."""
+    assert cfg.max_depth == 1, "sharded trainer covers the depth-1 config"
+    if bins is None:
+        bins = binning.bin_features(np.asarray(X), cfg.n_bins)
+    n_data = mesh.shape[DATA_AXIS]
+    n_model = mesh.shape[MODEL_AXIS]
+    F = bins.binned.shape[1]
+    bins_x, y_sorted, w_sorted, left_count, thresholds, F_pad, n_local = (
+        _prepare_shards(bins, y, n_data, n_model)
+    )
+
+    def put(a, spec):
+        return jax.device_put(np.asarray(a), NamedSharding(mesh, spec))
+
+    # shard layouts: leading data-shard axis folds into rows via shard_map.
+    # dtypes follow the backend (f64 under the x64 test config, f32 on TPU).
+    fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+    args = (
+        put(bins_x, P(DATA_AXIS, None, MODEL_AXIS, None)),
+        put(y_sorted.astype(fdt), P(DATA_AXIS, MODEL_AXIS, None)),
+        put(w_sorted.astype(fdt), P(DATA_AXIS, MODEL_AXIS, None)),
+        put(left_count, P(DATA_AXIS, MODEL_AXIS, None)),
+        put(thresholds.astype(fdt), P(MODEL_AXIS, None)),
+    )
+    feats, thrs, vals, splits, devs = _fit_sharded(
+        mesh,
+        *args,
+        n_stages=cfg.n_estimators,
+        learning_rate=cfg.learning_rate,
+        min_samples_leaf=cfg.min_samples_leaf,
+        min_samples_split=cfg.min_samples_split,
+    )
+    feats = np.asarray(feats)
+    # padded feature slots can never be selected; map back is identity on [0, F)
+    assert feats.max() < F
+    params = gbdt.forest_to_params(
+        jnp.asarray(feats),
+        jnp.asarray(thrs),
+        jnp.asarray(vals),
+        jnp.asarray(splits),
+        init_raw=gbdt._prior_log_odds(y),
+        learning_rate=cfg.learning_rate,
+        max_depth=1,
+    )
+    return params, {"train_deviance": np.asarray(devs)}
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "n_stages", "learning_rate", "min_samples_leaf", "min_samples_split",
+    ),
+)
+def _fit_sharded(
+    mesh,
+    bins_x,      # [S, F_pad, F_pad, n_local] uint8 (S = data shards)
+    y_sorted,    # [S, F_pad, n_local]
+    w_sorted,    # [S, F_pad, n_local]
+    left_count,  # [S, F_pad, B-1] int32
+    thresholds,  # [F_pad, B-1]
+    *,
+    n_stages: int,
+    learning_rate: float,
+    min_samples_leaf: int,
+    min_samples_split: int,
+):
+    from jax import shard_map
+
+    Bm1 = thresholds.shape[-1]
+
+    def local_loop(bx, ys, ws, lc, thr):
+        # Shapes inside shard_map (one data shard × one model shard):
+        #   bx [1, F_pad, F_loc, n_local] — query-feature axis unsharded
+        #   ys/ws [1, F_loc, n_local]; lc [1, F_loc, B-1]; thr [F_loc, B-1]
+        bx = bx[0]
+        ys = ys[0]
+        ws = ws[0]
+        lc = lc[0]
+        dtype = thr.dtype
+        F_loc, n_local = ys.shape
+        F_pad = bx.shape[0]
+        m_idx = jax.lax.axis_index(MODEL_AXIS)
+
+        n_real = jax.lax.psum(jnp.sum(ws[0]), DATA_AXIS)  # rows are real ⇔ w=1
+        sum_y = jax.lax.psum(jnp.sum(ys[0] * ws[0]), DATA_AXIS)
+        p1 = sum_y / n_real
+        f0 = jnp.log(p1 / (1.0 - p1))
+
+        def cumb(v):  # [F_loc, n_local] → global left-of-boundary sums [F_loc, B-1]
+            from machine_learning_replications_tpu.ops.histogram import (
+                cumulative_boundary_sums,
+            )
+
+            return jax.lax.psum(cumulative_boundary_sums(v, lc), DATA_AXIS)
+
+        CL = cumb(ws)  # weights never change: hoisted out of the stage loop
+
+        def stage(t, carry):
+            raw, feats, thrs_o, vals, splits, devs = carry  # raw [F_loc, n_local]
+            p = jax.scipy.special.expit(raw)
+            g = (ys - p) * ws
+            h = p * (1.0 - p) * ws
+            GL = cumb(g)
+            HL = cumb(h)
+            GT = jax.lax.psum(jnp.sum(g[0]), DATA_AXIS)
+            HT = jax.lax.psum(jnp.sum(h[0]), DATA_AXIS)
+            G2 = jax.lax.psum(jnp.sum(g[0] * g[0]), DATA_AXIS)
+
+            # local split scoring over this shard's features
+            GR = GT - GL
+            CR = n_real - CL
+            valid = (
+                (CL >= min_samples_leaf)
+                & (CR >= min_samples_leaf)
+                & jnp.isfinite(thr)
+            )
+            diff = GL / jnp.maximum(CL, 1) - GR / jnp.maximum(CR, 1)
+            proxy = jnp.where(valid, diff * diff * CL * CR, -jnp.inf)
+            flat = proxy.reshape(-1)
+            best_local = jnp.argmax(flat).astype(jnp.int32)
+            best_gain = flat[best_local]
+            # global best across the model axis (tie → lower shard index, which
+            # preserves first-feature-in-order tie-breaking)
+            gains = jax.lax.all_gather(best_gain, MODEL_AXIS)          # [M]
+            locs = jax.lax.all_gather(best_local, MODEL_AXIS)          # [M]
+            winner = jnp.argmax(gains).astype(jnp.int32)
+            w_loc = locs[winner]
+            f_local = w_loc // Bm1
+            bstar = w_loc % Bm1
+            fstar = (winner * F_loc + f_local).astype(jnp.int32)       # global feature id
+
+            # gather the winning boundary stats (every shard recomputes from
+            # its replicated GL/HL? GL is sharded by feature — all_gather the
+            # single winning row's scalars instead)
+            on_winner = winner == m_idx
+            sel = jnp.where(on_winner, 1.0, 0.0).astype(dtype)
+            num_l = jax.lax.psum(GL[f_local, bstar] * sel, MODEL_AXIS)
+            den_l = jax.lax.psum(HL[f_local, bstar] * sel, MODEL_AXIS)
+            # thr can be +inf off-winner; inf·0 = NaN, so mask before the psum
+            thr_star = jax.lax.psum(
+                jnp.where(on_winner, thr[f_local, bstar], 0.0), MODEL_AXIS
+            )
+            gain_star = gains[winner]
+            num_r, den_r = GT - num_l, HT - den_l
+
+            mean = GT / jnp.maximum(n_real, 1)
+            impurity = jnp.maximum(G2 / jnp.maximum(n_real, 1) - mean * mean, 0.0)
+            do = (
+                (n_real >= min_samples_split)
+                & (impurity > 2.220446049250313e-16)
+                & jnp.isfinite(gain_star)
+            )
+
+            def newton(num, den):
+                return jnp.where(
+                    jnp.abs(den) < _NEWTON_DEN_GUARD,
+                    0.0,
+                    num / jnp.where(jnp.abs(den) < _NEWTON_DEN_GUARD, 1.0, den),
+                )
+
+            v_root = newton(GT, HT)
+            v_l, v_r = newton(num_l, den_l), newton(num_r, den_r)
+
+            split_bins = jax.lax.dynamic_index_in_dim(
+                bx, fstar, axis=0, keepdims=False
+            )  # [F_loc, n_local]
+            go_left = split_bins <= bstar.astype(jnp.uint8)
+            contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
+            raw = raw + learning_rate * contrib
+
+            ll = jax.lax.psum(
+                jnp.sum((ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])) * ws[0]),
+                DATA_AXIS,
+            )
+            dev = -2.0 * ll / n_real
+
+            feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)
+            thr_t = jnp.stack(
+                [jnp.where(do, thr_star, jnp.inf),
+                 jnp.asarray(jnp.inf, dtype), jnp.asarray(jnp.inf, dtype)]
+            )
+            val_t = jnp.stack(
+                [jnp.where(do, 0.0, v_root),
+                 jnp.where(do, v_l, 0.0), jnp.where(do, v_r, 0.0)]
+            ).astype(dtype)
+            split_t = jnp.stack([do, jnp.array(False), jnp.array(False)])
+            return (
+                raw,
+                feats.at[t].set(feat_t),
+                thrs_o.at[t].set(thr_t),
+                vals.at[t].set(val_t),
+                splits.at[t].set(split_t),
+                devs.at[t].set(dev),
+            )
+
+        init = (
+            jnp.full((F_loc, n_local), f0, dtype),
+            jnp.zeros((n_stages, 3), jnp.int32),
+            jnp.full((n_stages, 3), jnp.inf, dtype),
+            jnp.zeros((n_stages, 3), dtype),
+            jnp.zeros((n_stages, 3), bool),
+            jnp.zeros(n_stages, dtype),
+        )
+        _, feats, thrs_o, vals, splits, devs = jax.lax.fori_loop(
+            0, n_stages, stage, init
+        )
+        # identical on every shard (computed from psum'd quantities)
+        return feats, thrs_o, vals, splits, devs
+
+    feats, thrs_o, vals, splits, devs = shard_map(
+        local_loop,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None, MODEL_AXIS, None),
+            P(DATA_AXIS, MODEL_AXIS, None),
+            P(DATA_AXIS, MODEL_AXIS, None),
+            P(DATA_AXIS, MODEL_AXIS, None),
+            P(MODEL_AXIS, None),
+        ),
+        out_specs=(P(), P(), P(), P(), P()),
+        check_vma=False,
+    )(bins_x, y_sorted, w_sorted, left_count, thresholds)
+    return feats, thrs_o, vals, splits, devs

@@ -1,0 +1,79 @@
+"""Multi-chip tests on the 8-device virtual CPU mesh (SURVEY.md §4).
+
+The sharded stump trainer must produce the *same forest* as the
+single-device trainer — communication (psum of histogram partials,
+all_gather of per-shard split bests) must be semantically invisible.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from machine_learning_replications_tpu.config import GBDTConfig
+from machine_learning_replications_tpu.models import gbdt, tree
+from machine_learning_replications_tpu.parallel import make_mesh, stump_trainer
+
+
+@pytest.fixture(scope="module")
+def train_data():
+    rng = np.random.default_rng(13)
+    n, f = 700, 17
+    X = rng.normal(size=(n, f))
+    X[:, :12] = (X[:, :12] > 0.4).astype(float)
+    X[:, 12:] = np.round(X[:, 12:] * 6) / 3
+    w = rng.normal(size=f)
+    y = (X @ w + 0.8 * rng.normal(size=n) > 0.3).astype(float)
+    return X, y
+
+
+@pytest.mark.parametrize("data,model", [(8, 1), (4, 2), (2, 4), (1, 1)])
+def test_sharded_equals_single_device(train_data, data, model):
+    if len(jax.devices()) < data * model:
+        pytest.skip("needs 8 virtual devices")
+    X, y = train_data
+    cfg = GBDTConfig(n_estimators=30, max_depth=1)
+    ref, aux_ref = gbdt.fit(X, y, cfg)
+    mesh = make_mesh(data=data, model=model)
+    sh, aux_sh = stump_trainer.fit(mesh, X, y, cfg)
+
+    np.testing.assert_array_equal(np.asarray(sh.feature), np.asarray(ref.feature))
+    np.testing.assert_allclose(
+        np.asarray(sh.threshold), np.asarray(ref.threshold), rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(sh.value), np.asarray(ref.value), rtol=1e-9, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        aux_sh["train_deviance"], aux_ref["train_deviance"], rtol=1e-9
+    )
+
+
+def test_sharded_matches_sklearn(train_data):
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    X, y = train_data
+    sk = GradientBoostingClassifier(n_estimators=25, max_depth=1, random_state=2020).fit(X, y)
+    mesh = make_mesh(data=4, model=2)
+    params, _ = stump_trainer.fit(mesh, X, y, GBDTConfig(n_estimators=25, max_depth=1))
+    np.testing.assert_allclose(
+        np.asarray(tree.raw_score(params, X[:100])),
+        sk.decision_function(X[:100]),
+        rtol=1e-9,
+    )
+
+
+def test_uneven_rows_padding(train_data):
+    # 697 rows over 8 shards → 88-row shards, 7 fabricated padding rows
+    X, y = train_data
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    X697, y697 = X[:697], y[:697]
+    cfg = GBDTConfig(n_estimators=10, max_depth=1)
+    ref, _ = gbdt.fit(X697, y697, cfg)
+    mesh = make_mesh(data=8, model=1)
+    sh, _ = stump_trainer.fit(mesh, X697, y697, cfg)
+    np.testing.assert_array_equal(np.asarray(sh.feature), np.asarray(ref.feature))
+    np.testing.assert_allclose(np.asarray(sh.value), np.asarray(ref.value), rtol=1e-9)
