@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Driver benchmark: GBDT-ensemble train wall-clock, TPU vs single-CPU sklearn.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <tpu seconds>, "unit": "s", "vs_baseline": <speedup>}
+
+The workload is BASELINE.json config 3 — the reference's
+``GradientBoostingClassifier(n_estimators=100, max_depth=1, random_state=2020)``
+(``train_ensemble_public.py:45``) — on a Table-S1-matched synthetic cohort
+(the reference ships no data; SURVEY.md §6), scaled to ``--rows`` rows
+(default 200k, per config 5's scaled-cohort direction). The baseline is
+sklearn fitting the identical estimator on the identical matrix on this
+host's CPU. ``vs_baseline`` is the wall-clock speedup (baseline / ours);
+the run also checks AUC-ROC parity within ±0.005 (BASELINE.json budget)
+and fails loudly if violated.
+
+Timing protocol: one compile/warmup fit first (XLA traces once), then the
+median of ``--repeats`` end-to-end fits — each timed fit includes host-side
+quantile binning, host→device transfer, and the full 100-stage boosting
+loop on device (``jax.block_until_ready``). The sklearn baseline is the
+median of ``--cpu-repeats`` fits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+import warnings
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--cpu-repeats", type=int, default=1)
+    ap.add_argument(
+        "--splitter", choices=("exact", "hist"), default="exact",
+        help="TPU split-search path (both are sklearn-parity on this cohort)",
+    )
+    args = ap.parse_args()
+
+    warnings.filterwarnings("ignore")
+    import jax
+    import numpy as np
+
+    from machine_learning_replications_tpu.config import GBDTConfig
+    from machine_learning_replications_tpu.data import make_cohort
+    from machine_learning_replications_tpu.data.schema import selected_indices
+    from machine_learning_replications_tpu.models import gbdt, tree
+    from machine_learning_replications_tpu.utils import metrics
+
+    device = jax.devices()[0]
+    X, y, _ = make_cohort(n=args.rows, seed=2020)
+    X17 = np.ascontiguousarray(X[:, selected_indices()], dtype=np.float32)
+    yf = np.asarray(y, dtype=np.float32)
+
+    # --- CPU sklearn baseline (the reference's exact estimator) -----------
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    cpu_times = []
+    for _ in range(args.cpu_repeats):
+        t0 = time.perf_counter()
+        sk = GradientBoostingClassifier(
+            n_estimators=100, max_depth=1, random_state=2020
+        ).fit(X17, y)
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_s = statistics.median(cpu_times)
+    auc_sk = float(metrics.roc_auc(y, sk.predict_proba(X17)[:, 1]))
+
+    # --- TPU-native fit ---------------------------------------------------
+    cfg = GBDTConfig(splitter=args.splitter)
+
+    def tpu_fit():
+        params, _ = gbdt.fit(X17, yf, cfg)
+        jax.block_until_ready(params.value)
+        return params
+
+    tpu_fit()  # compile + warm caches
+    tpu_times = []
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        params = tpu_fit()
+        tpu_times.append(time.perf_counter() - t0)
+    tpu_s = statistics.median(tpu_times)
+    auc_tpu = float(metrics.roc_auc(y, tree.predict_proba1(params, X17)))
+
+    auc_delta = abs(auc_tpu - auc_sk)
+    if auc_delta > 0.005:
+        print(
+            f"FAIL: AUC parity violated: tpu={auc_tpu:.6f} sklearn={auc_sk:.6f}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+    print(
+        f"rows={args.rows} device={device.device_kind} "
+        f"sklearn_cpu={cpu_s:.3f}s tpu={tpu_s:.3f}s "
+        f"auc sklearn={auc_sk:.6f} tpu={auc_tpu:.6f} (|Δ|={auc_delta:.2e})",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"gbdt100_train_wall_clock_{args.rows}rows",
+                "value": round(tpu_s, 4),
+                "unit": "s",
+                "vs_baseline": round(cpu_s / tpu_s, 3),
+                "baseline_wall_s": round(cpu_s, 4),
+                "auc_delta_vs_sklearn": round(auc_delta, 8),
+                "device": str(device.device_kind),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,7 +37,7 @@ from machine_learning_replications_tpu.config import GBDTConfig
 from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
 from machine_learning_replications_tpu.ops import binning, histogram
 
-_NEWTON_DEN_GUARD = 1e-150  # sklearn _update_terminal_region zero guard
+_NEWTON_DEN_GUARD = histogram.NEWTON_DEN_GUARD
 
 
 def fit(
@@ -156,13 +156,7 @@ def _fit_stumps(
         den_l = HL[0, fstar, bstar]
         num_r, den_r = GT - num_l, HT - den_l
 
-        def newton(num, den):
-            return jnp.where(
-                jnp.abs(den) < _NEWTON_DEN_GUARD,
-                0.0,
-                num / jnp.where(jnp.abs(den) < _NEWTON_DEN_GUARD, 1.0, den),
-            )
-
+        newton = histogram.newton_leaf_value
         v_root = newton(GT, HT)  # unsplit stage: single-leaf Newton value
         v_l, v_r = newton(num_l, den_l), newton(num_r, den_r)
 

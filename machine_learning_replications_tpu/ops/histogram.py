@@ -23,7 +23,19 @@ import jax
 import jax.numpy as jnp
 
 # sklearn's impurity-is-zero leaf test: impurity <= EPSILON (np.finfo(double).eps)
-_IMPURITY_EPS = 2.220446049250313e-16
+IMPURITY_EPS = 2.220446049250313e-16
+_IMPURITY_EPS = IMPURITY_EPS
+
+# sklearn _update_terminal_region zero guard on the Newton denominator
+NEWTON_DEN_GUARD = 1e-150
+
+
+def newton_leaf_value(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """Guarded Newton leaf value ``num/den`` (0 when |den| underflows) —
+    shared by the single-device and sharded trainers so their forests stay
+    bit-identical."""
+    tiny = jnp.abs(den) < NEWTON_DEN_GUARD
+    return jnp.where(tiny, 0.0, num / jnp.where(tiny, 1.0, den))
 
 
 class StumpData(NamedTuple):

@@ -20,8 +20,13 @@ crosses the host boundary per stage.
 
 Padding contracts: rows padded per shard carry weight 0 and bin ``B-1``
 (they sort past every candidate boundary, and all their sums are masked);
-features padded to a multiple of the model-axis size get +inf thresholds
-(never selectable).
+feature *sort-order slots* padded to a multiple of the model-axis size are
+coherent identity-order copies of the real data with +inf thresholds — they
+evolve the same raw scores as real slots but can never be selected, so every
+shard (including shards owning only padded slots) computes identical
+replicated outputs. Global scalar reductions additionally come from model
+shard 0 only (masked two-axis psum), making replication hold by
+construction rather than by the padding argument.
 """
 
 from __future__ import annotations
@@ -40,7 +45,10 @@ from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
 from machine_learning_replications_tpu.ops import binning
 from machine_learning_replications_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
-_NEWTON_DEN_GUARD = 1e-150
+from machine_learning_replications_tpu.ops.histogram import (  # noqa: E402
+    IMPURITY_EPS,
+    newton_leaf_value,
+)
 
 
 def _prepare_shards(
@@ -56,7 +64,9 @@ def _prepare_shards(
     F_pad = -(-F // n_model) * n_model
     n_local = -(-n // n_data)
 
-    bins_x = np.full((n_data, F_pad, F_pad, n_local), B - 1, np.uint8)
+    # Query-feature axis needs only the F real features (fstar < F always);
+    # the sort-order axis pads to F_pad for the model-axis shard split.
+    bins_x = np.full((n_data, F, F_pad, n_local), B - 1, np.uint8)
     y_sorted = np.zeros((n_data, F_pad, n_local), np.float32)
     w_sorted = np.zeros((n_data, F_pad, n_local), np.float32)
     left_count = np.zeros((n_data, F_pad, B - 1), np.int32)
@@ -74,32 +84,37 @@ def _prepare_shards(
         wl = np.concatenate([np.ones(k), np.zeros(n_local - k)])
         order = np.argsort(bl, axis=0, kind="stable")  # [n_local, F]
         for fs in range(F):
-            bins_x[s, :F, fs, :] = bl[order[:, fs], :].T
+            bins_x[s, :, fs, :] = bl[order[:, fs], :].T
             y_sorted[s, fs] = yl[order[:, fs]]
             w_sorted[s, fs] = wl[order[:, fs]]
             cnt = np.bincount(bl[:k, fs], minlength=B)
             left_count[s, fs] = np.cumsum(cnt)[:-1]
-        # padded feature slots: rows unsorted, weights zero — inert
+        # Padded sort-order slots: coherent identity-order copies of the real
+        # rows. Their raw scores evolve exactly like real slots (split routing
+        # reads the true bins), but left_count stays 0 and thresholds +inf so
+        # their candidate splits are never valid — required so shards whose
+        # every slot is padding still compute the replicated outputs.
         for fs in range(F, F_pad):
+            bins_x[s, :, fs, :] = bl.T
             y_sorted[s, fs] = yl
             w_sorted[s, fs] = wl
     return bins_x, y_sorted, w_sorted, left_count, thresholds, F_pad, n_local
 
 
-def fit(
+def _fit_raw(
     mesh: jax.sharding.Mesh,
     X: np.ndarray,
     y: np.ndarray,
-    cfg: GBDTConfig = GBDTConfig(),
+    cfg: GBDTConfig,
     bins: binning.BinnedFeatures | None = None,
-) -> tuple[TreeEnsembleParams, dict[str, Any]]:
-    """Depth-1 GBDT fit sharded over ``mesh`` (axes 'data' × 'model')."""
+):
+    """Prepare shards, place them on the mesh, run the sharded loop; returns
+    the raw (replicated) device arrays ``(feats, thrs, vals, splits, devs)``."""
     assert cfg.max_depth == 1, "sharded trainer covers the depth-1 config"
     if bins is None:
         bins = binning.bin_features(np.asarray(X), cfg.n_bins)
     n_data = mesh.shape[DATA_AXIS]
     n_model = mesh.shape[MODEL_AXIS]
-    F = bins.binned.shape[1]
     bins_x, y_sorted, w_sorted, left_count, thresholds, F_pad, n_local = (
         _prepare_shards(bins, y, n_data, n_model)
     )
@@ -117,7 +132,7 @@ def fit(
         put(left_count, P(DATA_AXIS, MODEL_AXIS, None)),
         put(thresholds.astype(fdt), P(MODEL_AXIS, None)),
     )
-    feats, thrs, vals, splits, devs = _fit_sharded(
+    return _fit_sharded(
         mesh,
         *args,
         n_stages=cfg.n_estimators,
@@ -125,6 +140,20 @@ def fit(
         min_samples_leaf=cfg.min_samples_leaf,
         min_samples_split=cfg.min_samples_split,
     )
+
+
+def fit(
+    mesh: jax.sharding.Mesh,
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: GBDTConfig = GBDTConfig(),
+    bins: binning.BinnedFeatures | None = None,
+) -> tuple[TreeEnsembleParams, dict[str, Any]]:
+    """Depth-1 GBDT fit sharded over ``mesh`` (axes 'data' × 'model')."""
+    if bins is None:
+        bins = binning.bin_features(np.asarray(X), cfg.n_bins)
+    F = bins.binned.shape[1]
+    feats, thrs, vals, splits, devs = _fit_raw(mesh, X, y, cfg, bins)
     feats = np.asarray(feats)
     # padded feature slots can never be selected; map back is identity on [0, F)
     assert feats.max() < F
@@ -165,7 +194,7 @@ def _fit_sharded(
 
     def local_loop(bx, ys, ws, lc, thr):
         # Shapes inside shard_map (one data shard × one model shard):
-        #   bx [1, F_pad, F_loc, n_local] — query-feature axis unsharded
+        #   bx [1, F, F_loc, n_local] — query-feature axis unsharded
         #   ys/ws [1, F_loc, n_local]; lc [1, F_loc, B-1]; thr [F_loc, B-1]
         bx = bx[0]
         ys = ys[0]
@@ -173,11 +202,20 @@ def _fit_sharded(
         lc = lc[0]
         dtype = thr.dtype
         F_loc, n_local = ys.shape
-        F_pad = bx.shape[0]
         m_idx = jax.lax.axis_index(MODEL_AXIS)
+        on0 = m_idx == 0
 
-        n_real = jax.lax.psum(jnp.sum(ws[0]), DATA_AXIS)  # rows are real ⇔ w=1
-        sum_y = jax.lax.psum(jnp.sum(ys[0] * ws[0]), DATA_AXIS)
+        def gsum(v):
+            """Global Σ over real rows of a per-row [n_local] quantity, taken
+            from model shard 0's slot-0 ordering and psum'd over BOTH axes —
+            replicated on every shard by construction."""
+            return jax.lax.psum(
+                jnp.where(on0, jnp.sum(v), 0.0).astype(dtype),
+                (DATA_AXIS, MODEL_AXIS),
+            )
+
+        n_real = gsum(ws[0])  # rows are real ⇔ w=1
+        sum_y = gsum(ys[0] * ws[0])
         p1 = sum_y / n_real
         f0 = jnp.log(p1 / (1.0 - p1))
 
@@ -197,9 +235,9 @@ def _fit_sharded(
             h = p * (1.0 - p) * ws
             GL = cumb(g)
             HL = cumb(h)
-            GT = jax.lax.psum(jnp.sum(g[0]), DATA_AXIS)
-            HT = jax.lax.psum(jnp.sum(h[0]), DATA_AXIS)
-            G2 = jax.lax.psum(jnp.sum(g[0] * g[0]), DATA_AXIS)
+            GT = gsum(g[0])
+            HT = gsum(h[0])
+            G2 = gsum(g[0] * g[0])
 
             # local split scoring over this shard's features
             GR = GT - GL
@@ -242,19 +280,13 @@ def _fit_sharded(
             impurity = jnp.maximum(G2 / jnp.maximum(n_real, 1) - mean * mean, 0.0)
             do = (
                 (n_real >= min_samples_split)
-                & (impurity > 2.220446049250313e-16)
+                & (impurity > IMPURITY_EPS)
                 & jnp.isfinite(gain_star)
             )
 
-            def newton(num, den):
-                return jnp.where(
-                    jnp.abs(den) < _NEWTON_DEN_GUARD,
-                    0.0,
-                    num / jnp.where(jnp.abs(den) < _NEWTON_DEN_GUARD, 1.0, den),
-                )
-
-            v_root = newton(GT, HT)
-            v_l, v_r = newton(num_l, den_l), newton(num_r, den_r)
+            v_root = newton_leaf_value(GT, HT)
+            v_l = newton_leaf_value(num_l, den_l)
+            v_r = newton_leaf_value(num_r, den_r)
 
             split_bins = jax.lax.dynamic_index_in_dim(
                 bx, fstar, axis=0, keepdims=False
@@ -263,10 +295,7 @@ def _fit_sharded(
             contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
             raw = raw + learning_rate * contrib
 
-            ll = jax.lax.psum(
-                jnp.sum((ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])) * ws[0]),
-                DATA_AXIS,
-            )
+            ll = gsum((ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])) * ws[0])
             dev = -2.0 * ll / n_real
 
             feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)

@@ -1,0 +1,185 @@
+"""Device-side evaluation metrics (L5').
+
+The reference evaluates with ``sklearn.metrics``: ``classification_report`` at
+threshold 0.5 (``train_ensemble_public.py:63-64``), ``plot_roc_curve`` with AUC
+(``:67-77``) and ``plot_precision_recall_curve`` (``:79-88``), each wrapped in a
+95% Wald confidence band ``1.96*sqrt(p*(1-p)/n)`` (``:76,:84``).
+
+This module computes the same quantities on device with static shapes so they
+can live inside a jitted eval step (SURVEY.md §5 "Metrics"): AUC via the
+rank-statistic (Mann-Whitney) form with proper tie handling, ROC/PR curves as
+fixed-length cumulative scans over the score-sorted order, and a
+``classification_report``-equivalent returned as arrays rather than a string.
+Plotting stays on host (``plots.py``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _average_ranks(scores: jnp.ndarray) -> jnp.ndarray:
+    """1-based ranks with ties given their group-average rank."""
+    s = jnp.sort(scores)
+    lo = jnp.searchsorted(s, scores, side="left")
+    hi = jnp.searchsorted(s, scores, side="right")
+    return 0.5 * (lo + hi + 1).astype(s.dtype)
+
+
+@jax.jit
+def roc_auc(y_true: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
+    """AUC-ROC = P(score⁺ > score⁻) + ½P(tie), via average ranks.
+
+    Equals sklearn's trapezoidal ``roc_auc_score`` exactly (including tied
+    scores). Returns NaN when a class is empty, as sklearn raises there.
+    """
+    y = y_true.astype(scores.dtype)
+    n_pos = jnp.sum(y)
+    n_neg = y.shape[0] - n_pos
+    r = _average_ranks(scores)
+    u = jnp.sum(r * y) - n_pos * (n_pos + 1.0) / 2.0
+    return u / (n_pos * n_neg)
+
+
+class RocCurve(NamedTuple):
+    """Fixed-length ROC scan: point k uses the top-k scores as positives."""
+
+    fpr: jnp.ndarray  # [n+1]
+    tpr: jnp.ndarray  # [n+1]
+    thresholds: jnp.ndarray  # [n+1] — descending; [0] is +inf (no positives)
+
+
+@jax.jit
+def roc_curve(y_true: jnp.ndarray, scores: jnp.ndarray) -> RocCurve:
+    """ROC points over every score cut, in descending-threshold order.
+
+    Shape is static ([n+1]); sklearn's variant drops collinear/tied points,
+    which only thins the polyline — the trapezoid area is identical (tied
+    thresholds yield repeated points that contribute zero area).
+    """
+    order = jnp.argsort(-scores)
+    y = y_true[order].astype(scores.dtype)
+    tp = jnp.concatenate([jnp.zeros(1, y.dtype), jnp.cumsum(y)])
+    fp = jnp.concatenate([jnp.zeros(1, y.dtype), jnp.cumsum(1.0 - y)])
+    n_pos = tp[-1]
+    n_neg = fp[-1]
+    thr = jnp.concatenate([jnp.array([jnp.inf], scores.dtype), scores[order]])
+    return RocCurve(fpr=fp / n_neg, tpr=tp / n_pos, thresholds=thr)
+
+
+class PrCurve(NamedTuple):
+    precision: jnp.ndarray  # [n+1] — ends at 1.0 (zero-recall convention)
+    recall: jnp.ndarray     # [n+1] — descending from 1 to 0
+    thresholds: jnp.ndarray  # [n]
+
+
+@jax.jit
+def precision_recall_curve(y_true: jnp.ndarray, scores: jnp.ndarray) -> PrCurve:
+    """PR points over every cut (sklearn convention: recall descends to 0,
+    final precision pinned to 1). Tied thresholds yield repeated points."""
+    order = jnp.argsort(-scores)
+    y = y_true[order].astype(scores.dtype)
+    tp = jnp.cumsum(y)
+    k = jnp.arange(1, y.shape[0] + 1, dtype=y.dtype)
+    n_pos = tp[-1]
+    # Walk from the smallest threshold up (reverse of the sorted order).
+    precision = jnp.concatenate([(tp / k)[::-1], jnp.ones(1, y.dtype)])
+    recall = jnp.concatenate([(tp / n_pos)[::-1], jnp.zeros(1, y.dtype)])
+    return PrCurve(
+        precision=precision, recall=recall, thresholds=scores[order][::-1]
+    )
+
+
+@jax.jit
+def average_precision(y_true: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
+    """AP = Σ (R_k − R_{k−1}) · P_k over descending thresholds (sklearn def).
+
+    With tied scores sklearn collapses ties before summing; here each tied
+    row contributes its own step, which telescopes to the same value only
+    when precision is constant across the tie — for continuous scores
+    (the framework's use) the two agree to machine precision.
+    """
+    pr = precision_recall_curve(y_true, scores)
+    # recall descends; steps are negative diffs
+    dr = pr.recall[:-1] - pr.recall[1:]
+    return jnp.sum(dr * pr.precision[:-1])
+
+
+class ClassificationReport(NamedTuple):
+    """Per-class arrays indexed [neg, pos] — the classification_report fields."""
+
+    precision: jnp.ndarray  # [2]
+    recall: jnp.ndarray     # [2]
+    f1: jnp.ndarray         # [2]
+    support: jnp.ndarray    # [2]
+    accuracy: jnp.ndarray   # []
+    macro_avg: jnp.ndarray      # [3] precision/recall/f1
+    weighted_avg: jnp.ndarray   # [3]
+
+
+@jax.jit
+def classification_report(
+    y_true: jnp.ndarray, y_pred: jnp.ndarray
+) -> ClassificationReport:
+    """Binary classification_report (reference eval at threshold 0.5,
+    ``train_ensemble_public.py:63-64``) as device arrays."""
+    yt = y_true.astype(jnp.float32)
+    yp = y_pred.astype(jnp.float32)
+    out = []
+    for cls in (0.0, 1.0):
+        t = jnp.where(cls == 1.0, yt, 1.0 - yt)
+        p = jnp.where(cls == 1.0, yp, 1.0 - yp)
+        tp = jnp.sum(t * p)
+        prec = tp / jnp.maximum(jnp.sum(p), 1.0)
+        rec = tp / jnp.maximum(jnp.sum(t), 1.0)
+        f1 = jnp.where(
+            prec + rec > 0.0, 2.0 * prec * rec / (prec + rec), 0.0
+        )
+        out.append((prec, rec, f1, jnp.sum(t)))
+    precision = jnp.stack([out[0][0], out[1][0]])
+    recall = jnp.stack([out[0][1], out[1][1]])
+    f1 = jnp.stack([out[0][2], out[1][2]])
+    support = jnp.stack([out[0][3], out[1][3]])
+    acc = jnp.mean((yt == yp).astype(jnp.float32))
+    w = support / jnp.sum(support)
+    macro = jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)])
+    weighted = jnp.stack(
+        [jnp.sum(w * precision), jnp.sum(w * recall), jnp.sum(w * f1)]
+    )
+    return ClassificationReport(
+        precision=precision, recall=recall, f1=f1, support=support,
+        accuracy=acc, macro_avg=macro, weighted_avg=weighted,
+    )
+
+
+def wald_ci_halfwidth(p: jnp.ndarray, n: int | jnp.ndarray) -> jnp.ndarray:
+    """95% Wald band half-width ``1.96*sqrt(p*(1-p)/n)`` — the reference's
+    hand-rolled CI formula (``train_ensemble_public.py:76,:84``)."""
+    return 1.96 * jnp.sqrt(p * (1.0 - p) / n)
+
+
+def report_text(rep: ClassificationReport) -> str:
+    """Host-side pretty printer mirroring sklearn's report layout."""
+    import numpy as np
+
+    rows = []
+    hdr = f"{'':>12} {'precision':>9} {'recall':>9} {'f1-score':>9} {'support':>9}"
+    rows.append(hdr)
+    for i, name in enumerate(("0.0", "1.0")):
+        rows.append(
+            f"{name:>12} {float(rep.precision[i]):>9.2f} "
+            f"{float(rep.recall[i]):>9.2f} {float(rep.f1[i]):>9.2f} "
+            f"{int(np.asarray(rep.support[i])):>9d}"
+        )
+    n = int(np.asarray(jnp.sum(rep.support)))
+    rows.append("")
+    rows.append(f"{'accuracy':>12} {'':>9} {'':>9} {float(rep.accuracy):>9.2f} {n:>9d}")
+    for name, avg in (("macro avg", rep.macro_avg), ("weighted avg", rep.weighted_avg)):
+        rows.append(
+            f"{name:>12} {float(avg[0]):>9.2f} {float(avg[1]):>9.2f} "
+            f"{float(avg[2]):>9.2f} {n:>9d}"
+        )
+    return "\n".join(rows)

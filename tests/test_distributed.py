@@ -65,6 +65,45 @@ def test_sharded_matches_sklearn(train_data):
     )
 
 
+def _assert_buffers_replicated(mesh, X, y, cfg):
+    """Every device must hold bit-identical replicas of each output — the
+    P() out_spec's claim, which padded model shards once silently violated."""
+    for arr in stump_trainer._fit_raw(mesh, X, y, cfg):
+        shards = list(arr.addressable_shards)
+        ref = np.asarray(shards[0].data)
+        for s in shards[1:]:
+            np.testing.assert_array_equal(np.asarray(s.data), ref)
+
+
+@pytest.mark.parametrize("data,model", [(2, 4), (1, 8)])
+def test_padded_model_shards_replicated(train_data, data, model):
+    # F=5 on model=4 → F_pad=8, shard 3 owns only padded sort slots; on
+    # model=8 → shards 5..7 fully padded. Outputs must still be replicated
+    # and equal to the single-device forest.
+    if len(jax.devices()) < data * model:
+        pytest.skip("needs 8 virtual devices")
+    X, y = train_data
+    X5 = X[:, :5]
+    cfg = GBDTConfig(n_estimators=12, max_depth=1)
+    ref, aux_ref = gbdt.fit(X5, y, cfg)
+    mesh = make_mesh(data=data, model=model)
+    sh, aux = stump_trainer.fit(mesh, X5, y, cfg)
+    np.testing.assert_array_equal(np.asarray(sh.feature), np.asarray(ref.feature))
+    np.testing.assert_allclose(np.asarray(sh.value), np.asarray(ref.value), rtol=1e-9)
+    np.testing.assert_allclose(
+        aux["train_deviance"], aux_ref["train_deviance"], rtol=1e-9
+    )
+    _assert_buffers_replicated(mesh, X5, y, cfg)
+
+
+def test_full_mesh_buffers_replicated(train_data):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    X, y = train_data
+    mesh = make_mesh(data=4, model=2)
+    _assert_buffers_replicated(mesh, X, y, GBDTConfig(n_estimators=10, max_depth=1))
+
+
 def test_uneven_rows_padding(train_data):
     # 697 rows over 8 shards → 88-row shards, 7 fabricated padding rows
     X, y = train_data

@@ -1,0 +1,83 @@
+"""Device-side metrics vs sklearn.metrics (differential tests, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from machine_learning_replications_tpu.utils import metrics
+
+
+@pytest.fixture(scope="module")
+def scored():
+    r = np.random.default_rng(7)
+    y = (r.random(400) < 0.3).astype(np.float64)
+    s = np.clip(r.normal(0.3 + 0.3 * y, 0.25), 0, 1)
+    return y, s
+
+
+def test_roc_auc_matches_sklearn(scored):
+    y, s = scored
+    assert float(metrics.roc_auc(y, s)) == pytest.approx(
+        skm.roc_auc_score(y, s), abs=1e-12
+    )
+
+
+def test_roc_auc_with_ties():
+    r = np.random.default_rng(3)
+    y = (r.random(300) < 0.4).astype(np.float64)
+    s = np.round(r.random(300), 1)  # heavy ties
+    assert float(metrics.roc_auc(y, s)) == pytest.approx(
+        skm.roc_auc_score(y, s), abs=1e-12
+    )
+
+
+def test_roc_curve_area_and_points(scored):
+    y, s = scored
+    rc = metrics.roc_curve(y, s)
+    area = np.trapezoid(np.asarray(rc.tpr), np.asarray(rc.fpr))
+    assert area == pytest.approx(skm.roc_auc_score(y, s), abs=1e-12)
+    # Every sklearn ROC vertex appears in our dense polyline.
+    fpr_sk, tpr_sk, _ = skm.roc_curve(y, s)
+    ours = {(round(a, 10), round(b, 10)) for a, b in zip(np.asarray(rc.fpr), np.asarray(rc.tpr))}
+    for a, b in zip(fpr_sk, tpr_sk):
+        assert (round(a, 10), round(b, 10)) in ours
+
+
+def test_pr_curve_and_average_precision(scored):
+    y, s = scored
+    pr = metrics.precision_recall_curve(y, s)
+    p_sk, r_sk, _ = skm.precision_recall_curve(y, s)
+    ours = {(round(a, 10), round(b, 10)) for a, b in zip(np.asarray(pr.precision), np.asarray(pr.recall))}
+    for a, b in zip(p_sk, r_sk):
+        assert (round(a, 10), round(b, 10)) in ours
+    assert float(metrics.average_precision(y, s)) == pytest.approx(
+        skm.average_precision_score(y, s), abs=1e-10
+    )
+
+
+def test_classification_report_matches_sklearn(scored):
+    y, s = scored
+    yp = (s > 0.5).astype(np.float64)
+    rep = metrics.classification_report(y, yp)
+    sk = skm.classification_report(y, yp, output_dict=True)
+    for i, cls in enumerate(("0.0", "1.0")):
+        assert float(rep.precision[i]) == pytest.approx(sk[cls]["precision"], abs=1e-6)
+        assert float(rep.recall[i]) == pytest.approx(sk[cls]["recall"], abs=1e-6)
+        assert float(rep.f1[i]) == pytest.approx(sk[cls]["f1-score"], abs=1e-6)
+        assert int(rep.support[i]) == sk[cls]["support"]
+    assert float(rep.accuracy) == pytest.approx(sk["accuracy"], abs=1e-6)
+    assert float(rep.macro_avg[2]) == pytest.approx(sk["macro avg"]["f1-score"], abs=1e-6)
+    assert float(rep.weighted_avg[2]) == pytest.approx(
+        sk["weighted avg"]["f1-score"], abs=1e-6
+    )
+    assert "precision" in metrics.report_text(rep)
+
+
+def test_wald_ci_matches_reference_formula():
+    # train_ensemble_public.py:76 band formula
+    p = np.array([0.1, 0.5, 0.9])
+    np.testing.assert_allclose(
+        np.asarray(metrics.wald_ci_halfwidth(p, 100)),
+        1.96 * np.sqrt(p * (1 - p) / 100),
+        rtol=1e-12,
+    )
