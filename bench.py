@@ -38,7 +38,9 @@ def main() -> None:
     ap.add_argument("--cpu-repeats", type=int, default=1)
     ap.add_argument(
         "--splitter", choices=("exact", "hist"), default="exact",
-        help="TPU split-search path (both are sklearn-parity on this cohort)",
+        help="split search: 'exact' enumerates every unique-value midpoint "
+        "(sklearn BestSplitter semantics); 'hist' caps candidates at 256 "
+        "quantile bins (the scalable approximate path)",
     )
     args = ap.parse_args()
 

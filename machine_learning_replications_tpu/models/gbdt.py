@@ -37,8 +37,6 @@ from machine_learning_replications_tpu.config import GBDTConfig
 from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
 from machine_learning_replications_tpu.ops import binning, histogram
 
-_NEWTON_DEN_GUARD = histogram.NEWTON_DEN_GUARD
-
 
 def fit(
     X: np.ndarray,
@@ -48,7 +46,7 @@ def fit(
 ) -> tuple[TreeEnsembleParams, dict[str, Any]]:
     """Fit the boosted ensemble; returns (params, aux) with the deviance path."""
     if bins is None:
-        bins = binning.bin_features(np.asarray(X), cfg.n_bins)
+        bins = binning.bin_features(np.asarray(X), bin_budget(cfg))
     if cfg.max_depth == 1:
         # Gather/scatter-free fast path: replicated sorted layout
         # (ops.histogram.StumpData) — every stage is dense [F, n] math.
@@ -78,6 +76,109 @@ def fit(
         max_depth=cfg.max_depth,
     )
     return params, {"train_deviance": np.asarray(deviance)}
+
+
+def bin_budget(cfg: GBDTConfig) -> int | None:
+    """Bin cap implied by ``cfg.splitter``: 'exact' enumerates every
+    unique-value midpoint (sklearn ``BestSplitter`` parity, None = no cap);
+    'hist' quantizes to ``cfg.n_bins`` quantile bins (the scalable path).
+
+    Exact enumeration is only unbounded on the depth-1 fast path, whose
+    per-stage cost is independent of the candidate count. The level-wise
+    histogram path (depth ≥ 2) allocates O(2^depth · F · bins) per stage, so
+    it stays quantile-capped even under 'exact' — identical anyway whenever
+    feature cardinality ≤ ``n_bins``, which covers the reference cohort.
+    """
+    if cfg.splitter == "exact":
+        return None if cfg.max_depth == 1 else cfg.n_bins
+    if cfg.splitter == "hist":
+        return cfg.n_bins
+    raise ValueError(
+        f"unknown splitter {cfg.splitter!r}; expected 'exact' or 'hist'"
+    )
+
+
+def fit_resumable(
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: GBDTConfig = GBDTConfig(),
+    *,
+    checkpoint_dir: str,
+    checkpoint_every: int = 10,
+    bins: binning.BinnedFeatures | None = None,
+    _interrupt_after_chunks: int | None = None,
+) -> tuple[TreeEnsembleParams, dict[str, Any]]:
+    """``fit`` with Orbax checkpoint-and-restart every ``checkpoint_every``
+    boosting stages (SURVEY.md §5 "Failure detection" — the reference has no
+    recovery story at all; its scripts crash and restart from zero).
+
+    The checkpoint unit is the boosting carry (raw scores + forest tensors
+    + stage index). On entry, the newest step in ``checkpoint_dir`` is
+    restored and training continues from there; the chunk runner takes
+    dynamic stage bounds, so every chunk reuses one compiled program.
+    Deterministic stages ⇒ a resumed fit is bit-identical to an unbroken one.
+
+    ``_interrupt_after_chunks`` is a test hook: raise ``SimulatedInterrupt``
+    after that many chunks to emulate preemption.
+    """
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    if bins is None:
+        bins = binning.bin_features(np.asarray(X), bin_budget(cfg))
+    n_stages = cfg.n_estimators
+
+    if cfg.max_depth == 1:
+        sd = histogram.build_stump_data(bins, y)
+        carry = _stump_init(sd, n_stages)
+
+        def run(carry, s, e):
+            return _run_stumps(
+                sd, carry, s, e,
+                learning_rate=cfg.learning_rate,
+                min_samples_split=cfg.min_samples_split,
+                min_samples_leaf=cfg.min_samples_leaf,
+            )
+    else:
+        binned = jnp.asarray(bins.binned)
+        thresholds = jnp.asarray(bins.thresholds)
+        yj = jnp.asarray(y)
+        carry = _binned_init(thresholds, yj, n_stages, cfg.max_depth)
+
+        def run(carry, s, e):
+            return _run_binned(
+                binned, thresholds, yj, carry, s, e,
+                depth=cfg.max_depth, max_bins=bins.max_bins,
+                learning_rate=cfg.learning_rate,
+                min_samples_split=cfg.min_samples_split,
+                min_samples_leaf=cfg.min_samples_leaf,
+            )
+
+    with orbax_io.boosting_manager(checkpoint_dir) as mgr:
+        start = orbax_io.latest_step(mgr) or 0
+        if start:
+            carry = orbax_io.restore_step(mgr, start, carry)
+        chunks_done = 0
+        for s in range(start, n_stages, checkpoint_every):
+            e = min(s + checkpoint_every, n_stages)
+            carry = jax.block_until_ready(run(carry, s, e))
+            orbax_io.save_step(mgr, e, carry)
+            chunks_done += 1
+            if (
+                _interrupt_after_chunks is not None
+                and chunks_done >= _interrupt_after_chunks
+                and e < n_stages
+            ):
+                mgr.wait_until_finished()
+                raise orbax_io.SimulatedInterrupt(f"after stage {e}")
+        mgr.wait_until_finished()
+
+    _, feats, thrs, vals, splits, devs = carry
+    params = forest_to_params(
+        feats, thrs, vals, splits,
+        init_raw=_prior_log_odds(y), learning_rate=cfg.learning_rate,
+        max_depth=cfg.max_depth,
+    )
+    return params, {"train_deviance": np.asarray(devs)}
 
 
 def _prior_log_odds(y: np.ndarray) -> np.ndarray:
@@ -111,12 +212,6 @@ def forest_to_params(
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "n_stages", "learning_rate", "min_samples_split", "min_samples_leaf"
-    ),
-)
 def _fit_stumps(
     sd: histogram.StumpData,
     *,
@@ -125,15 +220,61 @@ def _fit_stumps(
     min_samples_split: int,
     min_samples_leaf: int,
 ):
-    """Depth-1 boosting (the reference's exact config) on the replicated
-    sorted layout: each stage is a handful of dense [F, n] passes — expit,
-    cumsum, static boundary lookups, one compare — with no dynamic
-    gather/scatter anywhere (TPU serializes those onto the scalar unit)."""
+    """Depth-1 boosting over the full stage range (single XLA program)."""
+    carry = _run_stumps(
+        sd,
+        _stump_init(sd, n_stages),
+        0,
+        n_stages,
+        learning_rate=learning_rate,
+        min_samples_split=min_samples_split,
+        min_samples_leaf=min_samples_leaf,
+    )
+    return carry[1:]
+
+
+def _stump_init(sd: histogram.StumpData, n_stages: int):
+    """Boosting carry at stage 0: replicated raw scores + preallocated
+    forest tensors. This carry is the unit of checkpoint/resume
+    (``persist.orbax_io`` saves it every k stages)."""
+    F, n = sd.y_sorted.shape
+    dtype = sd.thresholds.dtype
+    p1 = jnp.mean(sd.y_sorted[0].astype(dtype))
+    f0 = jnp.log(p1 / (1.0 - p1))
+    return (
+        jnp.full((F, n), f0, dtype),
+        jnp.zeros((n_stages, 3), jnp.int32),
+        jnp.full((n_stages, 3), jnp.inf, dtype),
+        jnp.zeros((n_stages, 3), dtype),
+        jnp.zeros((n_stages, 3), bool),
+        jnp.zeros(n_stages, dtype),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "learning_rate", "min_samples_split", "min_samples_leaf"
+    ),
+)
+def _run_stumps(
+    sd: histogram.StumpData,
+    carry,
+    start,
+    stop,
+    *,
+    learning_rate: float,
+    min_samples_split: int,
+    min_samples_leaf: int,
+):
+    """Run boosting stages ``[start, stop)`` on the replicated sorted layout:
+    each stage is a handful of dense [F, n] passes — expit, cumsum, static
+    boundary lookups, one compare — with no dynamic gather/scatter anywhere
+    (TPU serializes those onto the scalar unit). ``start``/``stop`` are
+    dynamic so checkpoint-resume chunks share one compilation."""
     F, n = sd.y_sorted.shape
     dtype = sd.thresholds.dtype
     ys = sd.y_sorted.astype(dtype)                # [F, n]
-    p1 = jnp.mean(ys[0])
-    f0 = jnp.log(p1 / (1.0 - p1))
     CL = sd.left_count.astype(dtype)[None]        # [1, F, B-1] — static counts
     CT = jnp.asarray([n], dtype)
 
@@ -163,8 +304,8 @@ def _fit_stumps(
         # bins of feature f* in every sort order: dense dynamic-slice + compare
         split_bins = jax.lax.dynamic_index_in_dim(
             sd.bins_x, fstar, axis=0, keepdims=False
-        )  # [F, n] uint8
-        go_left = split_bins <= bstar.astype(jnp.uint8)
+        )  # [F, n] — bin ids (uint8/16/32 per cardinality)
+        go_left = split_bins <= bstar.astype(split_bins.dtype)
         contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
         raw = raw + learning_rate * contrib
         dev = -2.0 * jnp.mean(ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0]))
@@ -184,25 +325,9 @@ def _fit_stumps(
             devs.at[t].set(dev),
         )
 
-    init = (
-        jnp.full((F, n), f0, dtype),
-        jnp.zeros((n_stages, 3), jnp.int32),
-        jnp.full((n_stages, 3), jnp.inf, dtype),
-        jnp.zeros((n_stages, 3), dtype),
-        jnp.zeros((n_stages, 3), bool),
-        jnp.zeros(n_stages, dtype),
-    )
-    _, feats, thrs, vals, splits, devs = jax.lax.fori_loop(0, n_stages, stage, init)
-    return feats, thrs, vals, splits, devs
+    return jax.lax.fori_loop(start, stop, stage, carry)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "n_stages", "depth", "max_bins",
-        "min_samples_split", "min_samples_leaf",
-    ),
-)
 def _fit_binned(
     binned: jnp.ndarray,      # [n, F] int32
     thresholds: jnp.ndarray,  # [F, B-1]
@@ -215,12 +340,59 @@ def _fit_binned(
     min_samples_split: int,
     min_samples_leaf: int,
 ):
+    carry = _run_binned(
+        binned, thresholds, y,
+        _binned_init(thresholds, y, n_stages, depth),
+        0, n_stages,
+        depth=depth, max_bins=max_bins, learning_rate=learning_rate,
+        min_samples_split=min_samples_split, min_samples_leaf=min_samples_leaf,
+    )
+    return carry[1:]
+
+
+def _binned_init(thresholds: jnp.ndarray, y: jnp.ndarray, n_stages: int, depth: int):
+    """Boosting carry at stage 0 for the general-depth path (the
+    checkpoint/resume unit, as ``_stump_init`` is for depth 1)."""
+    n = y.shape[0]
+    NN = 2 ** (depth + 1) - 1
+    dtype = thresholds.dtype
+    p1 = jnp.mean(y.astype(dtype))
+    f0 = jnp.log(p1 / (1.0 - p1))
+    return (
+        jnp.full(n, f0, dtype),
+        jnp.zeros((n_stages, NN), jnp.int32),
+        jnp.full((n_stages, NN), jnp.inf, dtype),
+        jnp.zeros((n_stages, NN), dtype),
+        jnp.zeros((n_stages, NN), bool),
+        jnp.zeros(n_stages, dtype),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "depth", "max_bins", "learning_rate",
+        "min_samples_split", "min_samples_leaf",
+    ),
+)
+def _run_binned(
+    binned: jnp.ndarray,      # [n, F] int32
+    thresholds: jnp.ndarray,  # [F, B-1]
+    y: jnp.ndarray,           # [n] ∈ {0, 1}
+    carry,
+    start,
+    stop,
+    *,
+    depth: int,
+    max_bins: int,
+    learning_rate: float,
+    min_samples_split: int,
+    min_samples_leaf: int,
+):
     n, F = binned.shape
     NN = 2 ** (depth + 1) - 1
     dtype = thresholds.dtype
     yf = y.astype(dtype)
-    p1 = jnp.mean(yf)
-    f0 = jnp.log(p1 / (1.0 - p1))
     rows = jnp.arange(n)
 
     def grow_tree(g, h):
@@ -253,7 +425,7 @@ def _fit_binned(
         # Newton leaf values over final row positions
         num = jax.ops.segment_sum(g, node, num_segments=NN)
         den = jax.ops.segment_sum(h, node, num_segments=NN)
-        val_t = jnp.where(jnp.abs(den) < _NEWTON_DEN_GUARD, 0.0, num / jnp.maximum(den, _NEWTON_DEN_GUARD))
+        val_t = histogram.newton_leaf_value(num, den)
         return feat_t, thr_t, val_t, split_t, node
 
     def stage(t, carry):
@@ -273,15 +445,4 @@ def _fit_binned(
             devs.at[t].set(dev),
         )
 
-    init = (
-        jnp.full(n, f0, dtype),
-        jnp.zeros((n_stages, NN), jnp.int32),
-        jnp.full((n_stages, NN), jnp.inf, dtype),
-        jnp.zeros((n_stages, NN), dtype),
-        jnp.zeros((n_stages, NN), bool),
-        jnp.zeros(n_stages, dtype),
-    )
-    _, feats, thrs, vals, splits, devs = jax.lax.fori_loop(
-        0, n_stages, stage, init
-    )
-    return feats, thrs, vals, splits, devs
+    return jax.lax.fori_loop(start, stop, stage, carry)

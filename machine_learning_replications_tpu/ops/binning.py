@@ -39,8 +39,12 @@ class BinnedFeatures:
         return self.thresholds.shape[1] + 1
 
 
-def bin_features(X: np.ndarray, n_bins: int = 256) -> BinnedFeatures:
+def bin_features(X: np.ndarray, n_bins: int | None = 256) -> BinnedFeatures:
     """Quantize ``X[n, F]`` column-wise into at most ``n_bins`` bins.
+
+    ``n_bins=None`` disables the cap: every unique-value midpoint becomes a
+    candidate threshold — the exact-enumeration regime of sklearn's
+    ``BestSplitter`` at any cardinality (``GBDTConfig.splitter='exact'``).
 
     A value lands in bin ``b`` = number of thresholds strictly below it;
     "split at boundary b" then means "go left iff bin <= b", and the
@@ -48,18 +52,22 @@ def bin_features(X: np.ndarray, n_bins: int = 256) -> BinnedFeatures:
     (a midpoint, matching sklearn's ``(v_i + v_{i+1})/2``).
     """
     n, F = X.shape
-    thresholds = np.full((F, n_bins - 1), np.inf)
-    counts = np.ones(F, np.int32)
-    binned = np.zeros((n, F), np.int32)
+    uniques = []
     for f in range(F):
         u = np.unique(X[:, f])  # sorted, NaN would sort last — reject it
         if np.isnan(u).any():
             raise ValueError(f"feature {f} contains NaN; impute before binning")
-        if u.size > n_bins:
+        if n_bins is not None and u.size > n_bins:
             # Quantile-spaced representative subset (keep extremes).
             q = np.linspace(0, 1, n_bins)
             idx = np.unique((q * (u.size - 1)).round().astype(int))
             u = u[idx]
+        uniques.append(u)
+    width = max(max(u.size for u in uniques) - 1, 1)
+    thresholds = np.full((F, width), np.inf)
+    counts = np.ones(F, np.int32)
+    binned = np.zeros((n, F), np.int32)
+    for f, u in enumerate(uniques):
         mids = (u[:-1] + u[1:]) / 2.0
         # sklearn guard (BestSplitter): if the midpoint rounds up to the upper
         # value, use the lower value as the threshold so the upper sample

@@ -55,7 +55,7 @@ class StumpData(NamedTuple):
     ``[F, B-1]`` per stage (SURVEY.md §2.5: histogram partials over ICI).
     """
 
-    bins_x: jnp.ndarray      # [F_query, F_sort, n] uint8 — bins of feature
+    bins_x: jnp.ndarray      # [F_query, F_sort, n] uint8/16/32 — bins of feature
                              #   f_q for rows in f_s's sorted order
     y_sorted: jnp.ndarray    # [F, n] — labels in each sort order
     left_count: jnp.ndarray  # [F, B-1] int — #rows with bin ≤ b (static CL)
@@ -68,10 +68,15 @@ def build_stump_data(bins, y, dtype=None) -> StumpData:
 
     b = np.asarray(bins.binned)
     n, F = b.shape
-    if bins.max_bins > 256:
-        raise ValueError("stump fast path stores bins as uint8 (max 256 bins)")
+    # Narrowest dtype that holds the bin ids (uint8 covers the capped 'hist'
+    # regime; wider types serve 'exact' enumeration at high cardinality).
+    bin_dtype = (
+        np.uint8 if bins.max_bins <= 256
+        else np.uint16 if bins.max_bins <= 65536
+        else np.int32
+    )
     order = np.argsort(b, axis=0, kind="stable")  # [n, F] — rows by each feature
-    bins_x = np.empty((F, F, n), np.uint8)
+    bins_x = np.empty((F, F, n), bin_dtype)
     y_sorted = np.empty((F, n), np.asarray(y).dtype)
     for fs in range(F):
         bins_x[:, fs, :] = b[order[:, fs], :].T

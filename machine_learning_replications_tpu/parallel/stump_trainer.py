@@ -59,14 +59,15 @@ def _prepare_shards(
     b = np.asarray(bins.binned)
     n, F = b.shape
     B = bins.max_bins
-    if B > 256:
-        raise ValueError("sharded stump trainer stores bins as uint8 (max 256 bins)")
+    # Narrowest dtype holding bin ids (mirrors ops.histogram.build_stump_data:
+    # uint8 for the capped 'hist' regime, wider for 'exact' enumeration).
+    bin_dtype = np.uint8 if B <= 256 else np.uint16 if B <= 65536 else np.int32
     F_pad = -(-F // n_model) * n_model
     n_local = -(-n // n_data)
 
     # Query-feature axis needs only the F real features (fstar < F always);
     # the sort-order axis pads to F_pad for the model-axis shard split.
-    bins_x = np.full((n_data, F, F_pad, n_local), B - 1, np.uint8)
+    bins_x = np.full((n_data, F, F_pad, n_local), B - 1, bin_dtype)
     y_sorted = np.zeros((n_data, F_pad, n_local), np.float32)
     w_sorted = np.zeros((n_data, F_pad, n_local), np.float32)
     left_count = np.zeros((n_data, F_pad, B - 1), np.int32)
@@ -112,7 +113,7 @@ def _fit_raw(
     the raw (replicated) device arrays ``(feats, thrs, vals, splits, devs)``."""
     assert cfg.max_depth == 1, "sharded trainer covers the depth-1 config"
     if bins is None:
-        bins = binning.bin_features(np.asarray(X), cfg.n_bins)
+        bins = binning.bin_features(np.asarray(X), gbdt.bin_budget(cfg))
     n_data = mesh.shape[DATA_AXIS]
     n_model = mesh.shape[MODEL_AXIS]
     bins_x, y_sorted, w_sorted, left_count, thresholds, F_pad, n_local = (
@@ -151,7 +152,7 @@ def fit(
 ) -> tuple[TreeEnsembleParams, dict[str, Any]]:
     """Depth-1 GBDT fit sharded over ``mesh`` (axes 'data' × 'model')."""
     if bins is None:
-        bins = binning.bin_features(np.asarray(X), cfg.n_bins)
+        bins = binning.bin_features(np.asarray(X), gbdt.bin_budget(cfg))
     F = bins.binned.shape[1]
     feats, thrs, vals, splits, devs = _fit_raw(mesh, X, y, cfg, bins)
     feats = np.asarray(feats)
@@ -177,7 +178,8 @@ def fit(
 )
 def _fit_sharded(
     mesh,
-    bins_x,      # [S, F_pad, F_pad, n_local] uint8 (S = data shards)
+    bins_x,      # [S, F, F_pad, n_local] bin ids (S = data shards; query
+                 #   axis unpadded — fstar always indexes a real feature)
     y_sorted,    # [S, F_pad, n_local]
     w_sorted,    # [S, F_pad, n_local]
     left_count,  # [S, F_pad, B-1] int32
@@ -291,7 +293,7 @@ def _fit_sharded(
             split_bins = jax.lax.dynamic_index_in_dim(
                 bx, fstar, axis=0, keepdims=False
             )  # [F_loc, n_local]
-            go_left = split_bins <= bstar.astype(jnp.uint8)
+            go_left = split_bins <= bstar.astype(split_bins.dtype)
             contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
             raw = raw + learning_rate * contrib
 

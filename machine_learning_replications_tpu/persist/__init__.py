@@ -19,6 +19,19 @@ from machine_learning_replications_tpu.persist.sklearn_import import (
     import_svc,
 )
 
+# Orbax names resolve lazily (PEP 562) so the pickle-import path stays usable
+# in environments without orbax-checkpoint installed.
+_ORBAX_NAMES = ("abstract_like", "restore_params", "save_params")
+
+
+def __getattr__(name):
+    if name in _ORBAX_NAMES:
+        from machine_learning_replications_tpu.persist import orbax_io
+
+        return getattr(orbax_io, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "REFERENCE_PKL_PATH",
     "decode_pickle",
@@ -27,4 +40,7 @@ __all__ = [
     "import_linear",
     "import_scaler",
     "import_svc",
+    "abstract_like",
+    "restore_params",
+    "save_params",
 ]

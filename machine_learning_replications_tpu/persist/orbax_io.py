@@ -1,0 +1,89 @@
+"""Orbax checkpointing — the TPU-native replacement for the reference's pickle.
+
+The reference persists its fitted model as one opaque pickle
+(``predict_hf.py:33-34``; ``HF/hf_predict_model.pkl``) and has **no**
+mid-training checkpointing or restart story at all (SURVEY.md §5 "Failure
+detection": scripts crash on any error). Here:
+
+  * ``save_params`` / ``restore_params`` — whole-model pytree checkpoints
+    (``StackingParams``, ``TreeEnsembleParams``, …) via
+    ``orbax.checkpoint.StandardCheckpointer``. Restore takes a *template*
+    pytree supplying structure, dtypes, and non-array static fields
+    (e.g. ``TreeEnsembleParams.max_depth``); use ``abstract_like`` to turn a
+    concrete pytree into a shape/dtype-only template.
+  * ``boosting_manager`` — a ``CheckpointManager`` over the boosting carry,
+    used by ``models.gbdt.fit_resumable`` to checkpoint every k stages and
+    resume after preemption (SURVEY.md §5 "Orbax checkpoint-and-restart per
+    boosting stage").
+
+Checkpoints are directories of tensorstore arrays — sharded arrays save and
+restore with their ``NamedSharding`` preserved, so the same code path serves
+single-chip and mesh-sharded state.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class SimulatedInterrupt(RuntimeError):
+    """Raised by test hooks to emulate preemption mid-training."""
+
+
+def abstract_like(params: Any) -> Any:
+    """Shape/dtype/sharding template of a pytree (statics kept by the tree
+    structure). Sharding is carried over from concrete ``jax.Array`` leaves so
+    a mesh-sharded checkpoint restores onto the *caller's* topology rather
+    than whatever layout the checkpoint file recorded."""
+
+    def leaf(x):
+        sharding = x.sharding if isinstance(x, jax.Array) else None
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return jax.tree.map(leaf, params)
+
+
+def save_params(path: str | os.PathLike, params: Any, *, force: bool = True) -> None:
+    """Write ``params`` (any pytree of arrays) as an Orbax checkpoint at
+    ``path`` (created; overwritten when ``force``). Blocks until durable."""
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(os.fspath(path)), params, force=force)
+
+
+def restore_params(path: str | os.PathLike, template: Any) -> Any:
+    """Read the checkpoint at ``path`` into the structure of ``template``
+    (a concrete pytree or one from ``abstract_like``)."""
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(os.fspath(path)), template)
+
+
+def boosting_manager(
+    directory: str | os.PathLike, *, max_to_keep: int = 2
+) -> ocp.CheckpointManager:
+    """Step-indexed manager for the boosting carry (step = stages completed).
+
+    Keeps the newest ``max_to_keep`` steps — enough to survive a failure
+    during a save — and cleans up older ones.
+    """
+    return ocp.CheckpointManager(
+        os.path.abspath(os.fspath(directory)),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+def save_step(mgr: ocp.CheckpointManager, step: int, carry: Any) -> None:
+    mgr.save(step, args=ocp.args.StandardSave(carry))
+
+
+def latest_step(mgr: ocp.CheckpointManager) -> int | None:
+    return mgr.latest_step()
+
+
+def restore_step(mgr: ocp.CheckpointManager, step: int, template: Any) -> Any:
+    return mgr.restore(step, args=ocp.args.StandardRestore(abstract_like(template)))

@@ -116,3 +116,30 @@ def test_uneven_rows_padding(train_data):
     sh, _ = stump_trainer.fit(mesh, X697, y697, cfg)
     np.testing.assert_array_equal(np.asarray(sh.feature), np.asarray(ref.feature))
     np.testing.assert_allclose(np.asarray(sh.value), np.asarray(ref.value), rtol=1e-9)
+
+
+def test_sharded_exact_high_cardinality(cohort_full):
+    """Full-size cohort (1427 unique values in the continuous columns) through
+    the sharded trainer under the default exact splitter — pins the uint16
+    stump layout; fixtures elsewhere stay under 256 uniques and would miss a
+    uint8 regression."""
+    import numpy as np
+
+    from machine_learning_replications_tpu.config import GBDTConfig
+    from machine_learning_replications_tpu.data.schema import selected_indices
+    from machine_learning_replications_tpu.models import gbdt
+    from machine_learning_replications_tpu.parallel import make_mesh, stump_trainer
+
+    X, y, _ = cohort_full
+    Xs = np.asarray(X[:, selected_indices()])
+    assert max(len(np.unique(Xs[:, f])) for f in range(Xs.shape[1])) > 256
+    mesh = make_mesh(data=4, model=2)
+    cfg = GBDTConfig(n_estimators=8)  # splitter='exact' default
+    sharded, _ = stump_trainer.fit(mesh, Xs, y, cfg)
+    single, _ = gbdt.fit(Xs, y, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.feature), np.asarray(single.feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.value), np.asarray(single.value), rtol=1e-5, atol=1e-6
+    )

@@ -126,3 +126,40 @@ def test_quantized_regime_close():
     a1 = roc_auc_score(y, np.asarray(tree.predict_proba1(params, X)))
     a2 = roc_auc_score(y, sk.predict_proba(X)[:, 1])
     assert abs(a1 - a2) < 0.01
+
+
+def test_exact_splitter_high_cardinality():
+    """'exact' enumerates all unique midpoints even past 256 uniques
+    (uint16 stump layout) and matches sklearn stump-for-stump; 'hist'
+    quantizes. The reference workload never exceeds 256, but the scaled
+    configs do."""
+    rng = np.random.default_rng(11)
+    n = 700
+    X = np.stack(
+        [rng.normal(size=n), (rng.random(n) > 0.7).astype(float)], axis=1
+    )  # feature 0: ~700 unique values
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.6 * rng.normal(size=n) > 0).astype(float)
+
+    sk = GradientBoostingClassifier(
+        n_estimators=12, max_depth=1, random_state=0
+    ).fit(X, y)
+    ours, _ = gbdt.fit(X, y, GBDTConfig(n_estimators=12, splitter="exact"))
+    for m in range(12):
+        skt = sk.estimators_[m, 0].tree_
+        assert int(ours.feature[m, 0]) == int(skt.feature[0])
+        # sklearn casts X to float32 before midpoints; we keep float64,
+        # so thresholds agree only to float32 resolution.
+        np.testing.assert_allclose(
+            float(ours.threshold[m, 0]), float(skt.threshold[0]), rtol=1e-6
+        )
+
+    # hist (capped) still within the AUC budget, with far fewer candidates
+    from sklearn.metrics import roc_auc_score
+
+    h, _ = gbdt.fit(X, y, GBDTConfig(n_estimators=12, splitter="hist", n_bins=64))
+    auc_h = roc_auc_score(y, np.asarray(tree.predict_proba1(h, X)))
+    auc_sk = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    assert abs(auc_h - auc_sk) < 0.005
+
+    with pytest.raises(ValueError, match="unknown splitter"):
+        gbdt.fit(X, y, GBDTConfig(splitter="bogus"))

@@ -1,0 +1,110 @@
+"""Orbax persistence: whole-model round-trip + boosting checkpoint/resume.
+
+The reference's only persistence is one pickle written once and loaded by
+``predict_hf.py:33-34``; it has no mid-training recovery (SURVEY.md §5).
+These tests pin the framework's replacement: Orbax pytree checkpoints that
+round-trip exactly, and a resumable boosting loop whose post-preemption
+result is bit-identical to an unbroken fit.
+"""
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.config import GBDTConfig
+from machine_learning_replications_tpu.data.schema import selected_indices
+from machine_learning_replications_tpu.models import gbdt, stacking, tree
+from machine_learning_replications_tpu.persist import (
+    REFERENCE_PKL_PATH,
+    abstract_like,
+    decode_pickle,
+    import_stacking,
+    orbax_io,
+    restore_params,
+    save_params,
+)
+
+
+@pytest.fixture(scope="module")
+def fitted_forest(cohort_full):
+    X, y, _ = cohort_full
+    Xs = np.asarray(X[:, selected_indices()])
+    cfg = GBDTConfig(n_estimators=20)
+    params, aux = gbdt.fit(Xs, y, cfg)
+    return Xs, y, cfg, params, aux
+
+
+def test_forest_roundtrip(tmp_path, fitted_forest):
+    Xs, _, _, params, _ = fitted_forest
+    path = tmp_path / "forest"
+    save_params(path, params)
+    restored = restore_params(path, abstract_like(params))
+    assert restored.max_depth == params.max_depth  # static field via template
+    np.testing.assert_array_equal(
+        np.asarray(restored.feature), np.asarray(params.feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored.value), np.asarray(params.value)
+    )
+    np.testing.assert_allclose(
+        np.asarray(tree.predict_proba1(restored, Xs)),
+        np.asarray(tree.predict_proba1(params, Xs)),
+    )
+
+
+def test_stacking_roundtrip_from_reference_pkl(tmp_path):
+    params = import_stacking(decode_pickle(REFERENCE_PKL_PATH))
+    path = tmp_path / "stacked"
+    save_params(path, params)
+    restored = restore_params(path, abstract_like(params))
+    X = np.random.default_rng(7).normal(size=(32, 17))
+    np.testing.assert_array_equal(
+        np.asarray(stacking.predict_proba(restored, X)),
+        np.asarray(stacking.predict_proba(params, X)),
+    )
+
+
+def test_resumable_equals_unbroken(tmp_path, fitted_forest):
+    Xs, y, cfg, params, aux = fitted_forest
+    ckdir = tmp_path / "ck"
+    with pytest.raises(orbax_io.SimulatedInterrupt):
+        gbdt.fit_resumable(
+            Xs, y, cfg,
+            checkpoint_dir=str(ckdir), checkpoint_every=6,
+            _interrupt_after_chunks=2,
+        )
+    # "New process": resume from the surviving checkpoints.
+    resumed, aux2 = gbdt.fit_resumable(
+        Xs, y, cfg, checkpoint_dir=str(ckdir), checkpoint_every=6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.feature), np.asarray(params.feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.threshold), np.asarray(params.threshold)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.value), np.asarray(params.value)
+    )
+    np.testing.assert_array_equal(aux2["train_deviance"], aux["train_deviance"])
+
+
+def test_resumable_deeper_path(tmp_path, cohort_full):
+    X, y, _ = cohort_full
+    Xs = np.asarray(X[:, selected_indices()])
+    cfg = GBDTConfig(n_estimators=8, max_depth=2)
+    direct, _ = gbdt.fit(Xs, y, cfg)
+    with pytest.raises(orbax_io.SimulatedInterrupt):
+        gbdt.fit_resumable(
+            Xs, y, cfg,
+            checkpoint_dir=str(tmp_path / "ck2"), checkpoint_every=3,
+            _interrupt_after_chunks=1,
+        )
+    resumed, _ = gbdt.fit_resumable(
+        Xs, y, cfg, checkpoint_dir=str(tmp_path / "ck2"), checkpoint_every=3
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.feature), np.asarray(direct.feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.value), np.asarray(direct.value)
+    )
