@@ -45,6 +45,7 @@ def fit(
     bins: binning.BinnedFeatures | None = None,
 ) -> tuple[TreeEnsembleParams, dict[str, Any]]:
     """Fit the boosted ensemble; returns (params, aux) with the deviance path."""
+    resolve_backend(cfg)  # validate eagerly, even on paths that ignore it
     if bins is None:
         bins = binning.bin_features(np.asarray(X), bin_budget(cfg))
     if cfg.max_depth == 1:
@@ -69,6 +70,7 @@ def fit(
             learning_rate=cfg.learning_rate,
             min_samples_split=cfg.min_samples_split,
             min_samples_leaf=cfg.min_samples_leaf,
+            backend=resolve_backend(cfg),
         )
     params = forest_to_params(
         feature, threshold, value, is_split,
@@ -95,6 +97,20 @@ def bin_budget(cfg: GBDTConfig) -> int | None:
         return cfg.n_bins
     raise ValueError(
         f"unknown splitter {cfg.splitter!r}; expected 'exact' or 'hist'"
+    )
+
+
+def resolve_backend(cfg: GBDTConfig) -> str:
+    """'auto' → the Pallas histogram kernel on TPU, XLA segment_sum
+    elsewhere (the kernel still *runs* off-TPU via interpret mode, but
+    compiled scatter-adds win there)."""
+    if cfg.histogram_backend == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if cfg.histogram_backend in ("pallas", "xla"):
+        return cfg.histogram_backend
+    raise ValueError(
+        f"unknown histogram_backend {cfg.histogram_backend!r}; "
+        "expected 'auto', 'pallas' or 'xla'"
     )
 
 
@@ -151,6 +167,7 @@ def fit_resumable(
                 learning_rate=cfg.learning_rate,
                 min_samples_split=cfg.min_samples_split,
                 min_samples_leaf=cfg.min_samples_leaf,
+                backend=resolve_backend(cfg),
             )
 
     with orbax_io.boosting_manager(checkpoint_dir) as mgr:
@@ -339,6 +356,7 @@ def _fit_binned(
     learning_rate: float,
     min_samples_split: int,
     min_samples_leaf: int,
+    backend: str = "xla",
 ):
     carry = _run_binned(
         binned, thresholds, y,
@@ -346,6 +364,7 @@ def _fit_binned(
         0, n_stages,
         depth=depth, max_bins=max_bins, learning_rate=learning_rate,
         min_samples_split=min_samples_split, min_samples_leaf=min_samples_leaf,
+        backend=backend,
     )
     return carry[1:]
 
@@ -372,7 +391,7 @@ def _binned_init(thresholds: jnp.ndarray, y: jnp.ndarray, n_stages: int, depth: 
     jax.jit,
     static_argnames=(
         "depth", "max_bins", "learning_rate",
-        "min_samples_split", "min_samples_leaf",
+        "min_samples_split", "min_samples_leaf", "backend",
     ),
 )
 def _run_binned(
@@ -388,7 +407,14 @@ def _run_binned(
     learning_rate: float,
     min_samples_split: int,
     min_samples_leaf: int,
+    backend: str = "xla",
 ):
+    if backend == "pallas":
+        from machine_learning_replications_tpu.ops.pallas_histogram import (
+            node_histograms_pallas as hist_fn,
+        )
+    else:
+        hist_fn = histogram.node_histograms
     n, F = binned.shape
     NN = 2 ** (depth + 1) - 1
     dtype = thresholds.dtype
@@ -405,7 +431,7 @@ def _run_binned(
             base = 2**level - 1
             K = 2**level
             node_local = jnp.where(node >= base, node - base, -1)
-            hists = histogram.node_histograms(binned, node_local, g, h, K, max_bins)
+            hists = hist_fn(binned, node_local, g, h, K, max_bins)
             sp = histogram.best_splits(
                 hists, thresholds, min_samples_split, min_samples_leaf
             )
