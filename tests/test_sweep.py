@@ -1,0 +1,83 @@
+"""CV hyperparameter sweep (BASELINE.json config 4) and staged prediction."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from machine_learning_replications_tpu.config import GBDTConfig, SweepConfig
+from machine_learning_replications_tpu.data.schema import selected_indices
+from machine_learning_replications_tpu.models import gbdt, sweep, tree
+
+
+def test_staged_prefix_property(cohort_full):
+    """staged_proba1 at the full stage count must equal predict_proba1, and
+    the m-stage column must equal an independently trained m-stage model
+    (boosting stages are prefix-stable)."""
+    X, y, _ = cohort_full
+    Xs = X[:, selected_indices()]
+    full, _ = gbdt.fit(Xs, y, GBDTConfig(n_estimators=20))
+    p = sweep.staged_proba1(full, jnp.asarray(Xs), (5, 20))
+    np.testing.assert_allclose(
+        np.asarray(p[1]), np.asarray(tree.predict_proba1(full, Xs)),
+        rtol=1e-12, atol=1e-12,
+    )
+    short, _ = gbdt.fit(Xs, y, GBDTConfig(n_estimators=5))
+    np.testing.assert_allclose(
+        np.asarray(p[0]), np.asarray(tree.predict_proba1(short, Xs)),
+        rtol=1e-12, atol=1e-12,
+    )
+
+
+def test_cv_sweep_selects_and_refits(cohort_full):
+    X, y, _ = cohort_full
+    Xs = X[:, selected_indices()]
+    cfg = SweepConfig(
+        n_estimators_grid=(5, 15), max_depth_grid=(1, 2), cv_folds=3
+    )
+    res = sweep.cv_sweep(Xs, y, cfg)
+    assert res.fold_auc.shape == (2, 2, 3)
+    assert res.mean_auc.shape == (2, 2)
+    assert 0.5 < res.best_mean_auc <= 1.0
+    assert res.best_n_estimators in cfg.n_estimators_grid
+    assert res.best_max_depth in cfg.max_depth_grid
+    # the selected cell is the argmax of the mean surface
+    di = cfg.max_depth_grid.index(res.best_max_depth)
+    ei = cfg.n_estimators_grid.index(res.best_n_estimators)
+    assert res.mean_auc[di, ei] == res.mean_auc.max()
+
+    params, best_cfg = sweep.refit_best(Xs, y, res)
+    assert best_cfg.n_estimators == res.best_n_estimators
+    assert params.feature.shape[0] == res.best_n_estimators
+    p = tree.predict_proba1(params, Xs)
+    assert np.all((np.asarray(p) >= 0) & (np.asarray(p) <= 1))
+
+
+def test_sweep_matches_sklearn_gridsearch(cohort_full):
+    """Differential vs sklearn GridSearchCV on a small grid: per-cell mean
+    CV AUC within the parity budget (±0.005, BASELINE.json)."""
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.model_selection import GridSearchCV
+
+    X, y, _ = cohort_full
+    Xs = np.asarray(X[:, selected_indices()])
+    grid = {"n_estimators": [10, 30], "max_depth": [1, 2]}
+    gs = GridSearchCV(
+        GradientBoostingClassifier(random_state=2020),
+        grid,
+        scoring="roc_auc",
+        cv=3,
+    ).fit(Xs, y)
+    sk_auc = {
+        (p["max_depth"], p["n_estimators"]): m
+        for p, m in zip(
+            gs.cv_results_["params"], gs.cv_results_["mean_test_score"]
+        )
+    }
+    res = sweep.cv_sweep(
+        Xs, y,
+        SweepConfig(n_estimators_grid=(10, 30), max_depth_grid=(1, 2), cv_folds=3),
+    )
+    for di, d in enumerate(res.max_depth_grid):
+        for ei, e in enumerate(res.n_estimators_grid):
+            assert abs(res.mean_auc[di, ei] - sk_auc[(d, e)]) < 0.005, (d, e)
