@@ -1,0 +1,5 @@
+"""``python -m machine_learning_replications_tpu`` → the CLI (see ``cli.py``)."""
+
+from machine_learning_replications_tpu.cli import main
+
+raise SystemExit(main())

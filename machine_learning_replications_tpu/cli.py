@@ -1,0 +1,275 @@
+"""Command-line entry points (L6').
+
+The reference's "CLI" is two scripts edited in-source: ``python
+train_ensemble_public.py`` (expects ``develop_data.mat`` +
+``model_select_data.mat`` beside it, ``train_ensemble_public.py:34-39``)
+and ``python predict_hf.py`` (17 variables hard-coded at ``:5-27``, model
+path at ``:33``). Here the same flows — plus the framework's sweep and
+import tools — are real subcommands of
+``python -m machine_learning_replications_tpu``:
+
+  train           load (or synthesize) cohorts → impute → select → fit the
+                  stacking ensemble → report/AUC/plots → Orbax checkpoint
+  predict         load a model (Orbax dir, or the reference pickle) and
+                  print the probability for a patient (JSON or the built-in
+                  ``predict_hf.py:5-27`` example)
+  sweep           5-fold CV over the n_estimators × max_depth grid
+                  (BASELINE.json config 4)
+  import-sklearn  decode a legacy sklearn pickle → Orbax checkpoint
+
+Hyperparameters come from an ``ExperimentConfig`` JSON (``--config``);
+every flag the reference hard-codes has a config field (SURVEY.md §5
+"Config / flag system").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _load_cohort(args, which: str):
+    """(X64, y) from a .mat path or the synthetic generator."""
+    from machine_learning_replications_tpu import data
+
+    path = getattr(args, which, None)
+    if path:
+        X, y, _ = data.load_data(path)
+        return X, y
+    n = args.synthetic
+    # Two deterministic disjoint halves of n rows each (default 713, the
+    # reference's fit-split size — SURVEY.md §2.2).
+    X, y, _ = data.make_cohort(
+        n=2 * n, seed=args.seed, missing_rate=args.missing_rate
+    )
+    half = slice(0, n) if which == "develop" else slice(n, 2 * n)
+    return X[half], y[half]
+
+
+def _config(args):
+    from machine_learning_replications_tpu.config import ExperimentConfig
+
+    if args.config:
+        with open(args.config) as f:
+            return ExperimentConfig.from_json(f.read())
+    return ExperimentConfig()
+
+
+def cmd_train(args) -> int:
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.models import pipeline
+    from machine_learning_replications_tpu.utils import metrics
+
+    cfg = _config(args)
+    X_dev, y_dev = _load_cohort(args, "develop")
+    X_sel, y_sel = _load_cohort(args, "select")
+
+    params, info = pipeline.fit_pipeline(X_dev, y_dev, cfg)
+    print(f"selected {info['n_selected']} features", file=sys.stderr)
+
+    p1 = np.asarray(pipeline.pipeline_predict_proba1(params, X_sel))
+    yy = (p1 > 0.5).astype(np.float64)  # train_ensemble_public.py:63
+    rep = metrics.classification_report(jnp.asarray(y_sel), jnp.asarray(yy))
+    print(metrics.report_text(rep))
+    auc = float(metrics.roc_auc(jnp.asarray(y_sel), jnp.asarray(p1)))
+    ap = float(metrics.average_precision(jnp.asarray(y_sel), jnp.asarray(p1)))
+    print(f"AUC-ROC {auc:.4f}   average precision {ap:.4f}")
+
+    if args.plots:
+        from machine_learning_replications_tpu.utils import plots
+
+        os.makedirs(args.plots, exist_ok=True)
+        plots.roc_figure(
+            y_sel, p1, out_path=os.path.join(args.plots, "roc.png")
+        )
+        plots.pr_figure(
+            y_sel, p1, out_path=os.path.join(args.plots, "pr.png")
+        )
+        print(f"plots written to {args.plots}", file=sys.stderr)
+
+    if args.save:
+        from machine_learning_replications_tpu.persist import orbax_io
+
+        orbax_io.save_model(args.save, params)
+        print(f"model checkpointed to {args.save}", file=sys.stderr)
+    return 0
+
+
+def cmd_predict(args) -> int:
+    from machine_learning_replications_tpu.data.examples import (
+        EXAMPLE_PATIENT,
+        patient_row,
+    )
+
+    if args.patient:
+        with open(args.patient) as f:
+            patient = json.load(f)
+        unknown = set(patient) - set(EXAMPLE_PATIENT)
+        if unknown:
+            raise SystemExit(f"unknown patient variables: {sorted(unknown)}")
+        missing = [k for k in EXAMPLE_PATIENT if k not in patient]
+        if missing:
+            # The inference contract takes all 17 variables (predict_hf.py:5-27);
+            # silently defaulting clinical inputs would be unsafe.
+            raise SystemExit(
+                "patient JSON must provide all 17 variables; missing: "
+                + ", ".join(missing)
+            )
+    else:
+        patient = None
+
+    if args.model:
+        from machine_learning_replications_tpu.data.schema import selected_indices
+        from machine_learning_replications_tpu.models import pipeline, stacking, tree
+        from machine_learning_replications_tpu.persist import orbax_io
+
+        params = orbax_io.load_model(args.model)
+        if isinstance(params, pipeline.PipelineParams):
+            # A full-pipeline checkpoint selects its own lasso top-k columns
+            # (ascending index order, pipeline.py) — NOT the contractual
+            # 17-variable order. Route the patient through the pipeline:
+            # place the 17 known variables at their schema positions in a
+            # 64-wide row, leave the rest NaN for the KNN imputer (exactly
+            # the pipeline's missing-EHR-value story).
+            width = int(params.support_mask.shape[0])
+            x64 = np.full((1, width), np.nan)
+            x64[0, selected_indices()] = patient_row(patient).ravel()
+            prob = float(pipeline.pipeline_predict_proba1(params, x64)[0])
+        elif isinstance(params, tree.TreeEnsembleParams):
+            # `sweep --save` checkpoints: a bare GBDT fit on the contractual
+            # 17 columns (models.sweep trains on selected_indices() order).
+            x = patient_row(patient).reshape(1, -1)
+            prob = float(tree.predict_proba1(params, x)[0])
+        else:
+            x = patient_row(patient).reshape(1, -1)
+            prob = float(stacking.predict_proba1(params, x)[0])
+    else:
+        from machine_learning_replications_tpu.models import stacking
+        from machine_learning_replications_tpu.persist import (
+            REFERENCE_PKL_PATH,
+            decode_pickle,
+            import_stacking,
+        )
+
+        pkl = args.pkl or REFERENCE_PKL_PATH
+        params = import_stacking(decode_pickle(pkl))
+        x = patient_row(patient).reshape(1, -1)
+        prob = float(stacking.predict_proba1(params, x)[0])
+
+    # Output contract: predict_hf.py:38-40
+    print(f"Probability of progressive HF is: {100.0 * prob:.2f} %")
+    return 0
+
+
+def cmd_sweep(args) -> int:
+    from machine_learning_replications_tpu.config import SweepConfig
+    from machine_learning_replications_tpu.data.schema import selected_indices
+    from machine_learning_replications_tpu.models import knn_impute, sweep
+
+    import jax.numpy as jnp
+
+    X64, y = _load_cohort(args, "develop")
+    if np.isnan(X64).any():
+        _, X64 = knn_impute.fit_transform(jnp.asarray(X64))
+        X64 = np.asarray(X64)
+    X = X64[:, selected_indices()]
+
+    cfg = SweepConfig(
+        n_estimators_grid=tuple(args.n_estimators),
+        max_depth_grid=tuple(args.max_depth),
+        cv_folds=args.folds,
+    )
+    res = sweep.cv_sweep(X, y, cfg)
+    print(f"{'depth':>6} " + " ".join(f"m={m:>5d}" for m in res.n_estimators_grid))
+    for di, d in enumerate(res.max_depth_grid):
+        print(
+            f"{d:>6} "
+            + " ".join(f"{a:7.4f}" for a in res.mean_auc[di])
+        )
+    print(
+        f"best: n_estimators={res.best_n_estimators} "
+        f"max_depth={res.best_max_depth} mean AUC={res.best_mean_auc:.4f}"
+    )
+    if args.save:
+        from machine_learning_replications_tpu.persist import orbax_io
+
+        params, _ = sweep.refit_best(X, y, res)
+        orbax_io.save_model(args.save, params)
+        print(f"refit best model checkpointed to {args.save}", file=sys.stderr)
+    return 0
+
+
+def cmd_import_sklearn(args) -> int:
+    from machine_learning_replications_tpu.persist import (
+        REFERENCE_PKL_PATH,
+        decode_pickle,
+        import_stacking,
+        orbax_io,
+    )
+
+    pkl = args.pkl or REFERENCE_PKL_PATH
+    params = import_stacking(decode_pickle(pkl))
+    orbax_io.save_model(args.out, params)
+    print(f"imported {pkl} -> {args.out}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m machine_learning_replications_tpu",
+        description=__doc__.split("\n\n")[0],
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    def add_cohort_flags(p):
+        p.add_argument("--develop", help=".mat path of the development cohort")
+        p.add_argument("--select", help=".mat path of the model-select cohort")
+        p.add_argument(
+            "--synthetic", type=int, default=713,
+            help="rows per cohort when no .mat is given — two disjoint "
+            "halves of this size (Table-S1-matched generator; default 713, "
+            "the reference fit-split size)",
+        )
+        p.add_argument("--missing-rate", type=float, default=0.03)
+        p.add_argument("--seed", type=int, default=2020)
+        p.add_argument("--config", help="ExperimentConfig JSON path")
+
+    t = sub.add_parser("train", help="fit the full pipeline and evaluate")
+    add_cohort_flags(t)
+    t.add_argument("--save", help="Orbax checkpoint directory to write")
+    t.add_argument("--plots", help="directory for roc.png / pr.png")
+    t.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("predict", help="single-patient inference")
+    p.add_argument("--model", help="Orbax checkpoint dir from `train --save`")
+    p.add_argument("--pkl", help="legacy sklearn pickle (default: the reference artifact)")
+    p.add_argument("--patient", help="patient JSON file (default: predict_hf.py example)")
+    p.set_defaults(fn=cmd_predict)
+
+    s = sub.add_parser("sweep", help="5-fold CV grid sweep (config 4)")
+    add_cohort_flags(s)
+    s.add_argument("--n-estimators", type=int, nargs="+", default=[25, 50, 100, 200])
+    s.add_argument("--max-depth", type=int, nargs="+", default=[1, 2, 3])
+    s.add_argument("--folds", type=int, default=5)
+    s.add_argument("--save", help="checkpoint the refit best model here")
+    s.set_defaults(fn=cmd_sweep)
+
+    i = sub.add_parser("import-sklearn", help="legacy pickle → Orbax")
+    i.add_argument("--pkl", help="pickle path (default: the reference artifact)")
+    i.add_argument("--out", required=True, help="Orbax checkpoint directory")
+    i.set_defaults(fn=cmd_import_sklearn)
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,14 +34,19 @@ class SimulatedInterrupt(RuntimeError):
     """Raised by test hooks to emulate preemption mid-training."""
 
 
-def abstract_like(params: Any) -> Any:
-    """Shape/dtype/sharding template of a pytree (statics kept by the tree
-    structure). Sharding is carried over from concrete ``jax.Array`` leaves so
-    a mesh-sharded checkpoint restores onto the *caller's* topology rather
-    than whatever layout the checkpoint file recorded."""
+def abstract_like(params: Any, *, keep_sharding: bool = True) -> Any:
+    """Shape/dtype template of a pytree (statics kept by the tree structure).
+
+    With ``keep_sharding`` (the default), sharding is carried over from
+    concrete ``jax.Array`` leaves so a mesh-sharded checkpoint restores onto
+    the *caller's* topology rather than whatever layout the checkpoint file
+    recorded. ``save_model`` turns it off: shardings reference live device
+    objects and cannot be pickled into the sidecar template."""
 
     def leaf(x):
-        sharding = x.sharding if isinstance(x, jax.Array) else None
+        sharding = (
+            x.sharding if keep_sharding and isinstance(x, jax.Array) else None
+        )
         return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
     return jax.tree.map(leaf, params)
@@ -59,6 +64,36 @@ def restore_params(path: str | os.PathLike, template: Any) -> Any:
     (a concrete pytree or one from ``abstract_like``)."""
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore(os.path.abspath(os.fspath(path)), template)
+
+
+_TEMPLATE_FILE = "pytree_template.pkl"
+
+
+def save_model(path: str | os.PathLike, params: Any) -> None:
+    """``save_params`` plus a self-describing sidecar so the checkpoint can
+    be restored *without* the caller reconstructing a template pytree (the
+    CLI's load path). The sidecar pickles only ``jax.ShapeDtypeStruct``
+    leaves inside the params' own dataclass structure — written and read
+    exclusively by this module, never by sklearn-era code."""
+    import pickle
+
+    path = os.path.abspath(os.fspath(path))
+    save_params(path, params)
+    template = abstract_like(params, keep_sharding=False)
+    with open(os.path.join(path, _TEMPLATE_FILE), "wb") as f:
+        pickle.dump(template, f)
+
+
+def load_model(path: str | os.PathLike) -> Any:
+    """Restore a checkpoint written by ``save_model`` using its sidecar
+    template. Arrays land on the default device; re-shard afterwards for
+    mesh use (``data.shard_rows`` / ``NamedSharding``)."""
+    import pickle
+
+    path = os.path.abspath(os.fspath(path))
+    with open(os.path.join(path, _TEMPLATE_FILE), "rb") as f:
+        template = pickle.load(f)
+    return restore_params(path, template)
 
 
 def boosting_manager(

@@ -1,0 +1,138 @@
+"""CLI subcommands (L6') driven end-to-end through ``cli.main``.
+
+The reference's entry points are bare scripts with hard-coded inputs
+(``train_ensemble_public.py:34-39``, ``predict_hf.py:5-27``); these tests
+pin the subcommand equivalents, including the exact inference output
+contract "Probability of progressive HF is: XX.XX %" (``predict_hf.py:38-40``).
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu import cli
+
+_HAVE_REFERENCE_PKL = os.path.exists(
+    "/root/reference/Machine Learning for Predicting Heart Failure "
+    "Progression/hf_predict_model.pkl"
+)
+
+
+def _fast_config(tmp_path):
+    cfg = {
+        "gbdt": {"n_estimators": 5},
+        "svc": {"platt_cv": 2, "max_iter": 2000},
+        "stacking": {"cv_folds": 2},
+        "select": {"cv_folds": 3, "n_alphas": 20},
+    }
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+@pytest.mark.skipif(not _HAVE_REFERENCE_PKL, reason="reference pkl absent")
+def test_predict_reference_pickle(capsys):
+    assert cli.main(["predict"]) == 0
+    out = capsys.readouterr().out
+    m = re.search(r"Probability of progressive HF is: (\d+\.\d{2}) %", out)
+    assert m, out
+    # cross-check against the direct import path
+    from machine_learning_replications_tpu.data.examples import patient_row
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.persist import (
+        REFERENCE_PKL_PATH,
+        decode_pickle,
+        import_stacking,
+    )
+
+    params = import_stacking(decode_pickle(REFERENCE_PKL_PATH))
+    prob = float(stacking.predict_proba1(params, patient_row().reshape(1, -1))[0])
+    assert abs(float(m.group(1)) - 100 * prob) < 0.005
+
+
+@pytest.mark.skipif(not _HAVE_REFERENCE_PKL, reason="reference pkl absent")
+def test_predict_patient_json(tmp_path, capsys):
+    from machine_learning_replications_tpu.data.examples import EXAMPLE_PATIENT
+
+    patient = dict(EXAMPLE_PATIENT)
+    patient["Dyspnea"] = 1  # stump-0 split feature — must move the output
+    pj = tmp_path / "p.json"
+    pj.write_text(json.dumps(patient))
+    assert cli.main(["predict", "--patient", str(pj)]) == 0
+    out1 = capsys.readouterr().out
+    assert cli.main(["predict"]) == 0
+    out2 = capsys.readouterr().out
+    assert out1 != out2
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"Not_A_Variable": 1}))
+    with pytest.raises(SystemExit):
+        cli.main(["predict", "--patient", str(bad)])
+
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({"Dyspnea": 1}))
+    with pytest.raises(SystemExit, match="missing"):
+        cli.main(["predict", "--patient", str(partial)])
+
+
+def test_train_save_plots_predict_roundtrip(tmp_path, capsys):
+    ckpt = tmp_path / "model"
+    plots = tmp_path / "plots"
+    rc = cli.main([
+        "train",
+        "--synthetic", "160",
+        "--config", _fast_config(tmp_path),
+        "--save", str(ckpt),
+        "--plots", str(plots),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "AUC-ROC" in out and "precision" in out
+    assert (plots / "roc.png").exists() and (plots / "pr.png").exists()
+
+    assert cli.main(["predict", "--model", str(ckpt)]) == 0
+    out = capsys.readouterr().out
+    m = re.search(r"Probability of progressive HF is: (\d+\.\d{2}) %", out)
+    assert m
+
+    # The printed probability must equal routing the example patient through
+    # the pipeline itself (guards against feature-order mismatches between
+    # the contractual 17-variable row and the lasso-selected columns).
+    from machine_learning_replications_tpu.data.examples import patient_row
+    from machine_learning_replications_tpu.data.schema import selected_indices
+    from machine_learning_replications_tpu.models import pipeline
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    params = orbax_io.load_model(str(ckpt))
+    x64 = np.full((1, int(params.support_mask.shape[0])), np.nan)
+    x64[0, selected_indices()] = patient_row().ravel()
+    prob = float(pipeline.pipeline_predict_proba1(params, x64)[0])
+    assert abs(float(m.group(1)) - 100 * prob) < 0.005
+
+
+def test_sweep_cli(tmp_path, capsys):
+    rc = cli.main([
+        "sweep",
+        "--synthetic", "200",
+        "--n-estimators", "5", "10",
+        "--max-depth", "1", "2",
+        "--folds", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "best: n_estimators=" in out
+
+
+@pytest.mark.skipif(not _HAVE_REFERENCE_PKL, reason="reference pkl absent")
+def test_import_sklearn_roundtrip(tmp_path, capsys):
+    ckpt = tmp_path / "imported"
+    assert cli.main(["import-sklearn", "--out", str(ckpt)]) == 0
+    capsys.readouterr()
+    assert cli.main(["predict", "--model", str(ckpt)]) == 0
+    out_ckpt = capsys.readouterr().out
+    assert cli.main(["predict"]) == 0
+    out_pkl = capsys.readouterr().out
+    assert out_ckpt == out_pkl
