@@ -1,0 +1,15 @@
+"""Native (C++) runtime components.
+
+The reference's compute rests on native code inside third-party libraries
+(SURVEY.md §2.4); the TPU build keeps its own native layer in-repo:
+
+  * ``matio`` — MAT-v5 reader (``matio.cpp``, C ABI via ctypes), replacing
+    scipy's C parser on the ingest path (``HF/load_data_public.py:5``).
+
+Everything degrades gracefully: if the toolchain is absent the Python/scipy
+fallbacks take over (``data.matloader``).
+"""
+
+from machine_learning_replications_tpu.native import matio
+
+__all__ = ["matio"]

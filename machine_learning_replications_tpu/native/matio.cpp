@@ -1,0 +1,409 @@
+// Native MAT-v5 reader — the in-repo replacement for the scipy C parser the
+// reference's loader leans on (HF/load_data_public.py:5 → scipy.io.loadmat;
+// SURVEY.md §2.4 row "scipy.io.loadmat MAT-file reader").
+//
+// Scope: the Level-5 MAT format as MATLAB and scipy.io.savemat emit it for
+// tabular cohorts — numeric matrices of any integer/float storage type
+// (promoted to float64), char arrays, cell arrays of char arrays, and
+// zlib-compressed elements (MATLAB's default on-disk form). Little-endian
+// files only (every platform this framework targets). Column-major payloads
+// are surfaced as-is; the Python binding reshapes with order='F'.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t miINT8 = 1, miUINT8 = 2, miINT16 = 3, miUINT16 = 4,
+                   miINT32 = 5, miUINT32 = 6, miSINGLE = 7, miDOUBLE = 9,
+                   miINT64 = 12, miUINT64 = 13, miMATRIX = 14,
+                   miCOMPRESSED = 15, miUTF8 = 16, miUTF16 = 17;
+
+constexpr uint32_t mxCELL = 1, mxCHAR = 4;
+// numeric classes: mxDOUBLE=6 … mxUINT64=15 (contiguous range)
+
+struct Var {
+  std::string name;
+  int kind = 0;  // 0 numeric, 1 char, 2 cell-of-strings
+  std::vector<int64_t> dims;
+  std::vector<double> data;          // numeric payload, column-major
+  std::vector<std::string> strings;  // char rows / cell entries, column-major
+};
+
+struct MatFile {
+  std::vector<Var> vars;
+};
+
+struct Cursor {
+  const uint8_t* p;
+  size_t len;
+  size_t off = 0;
+  bool ok = true;
+  std::string err;
+
+  bool need(size_t n) {
+    if (off + n > len) {
+      ok = false;
+      err = "unexpected end of MAT data";
+      return false;
+    }
+    return true;
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v;
+    std::memcpy(&v, p + off, 4);
+    off += 4;
+    return v;
+  }
+};
+
+struct Element {
+  uint32_t type = 0;
+  const uint8_t* data = nullptr;
+  size_t size = 0;
+  bool ok = false;
+};
+
+// Read one data element (handles the small-element format); advances cur
+// past the element including its 8-byte alignment padding.
+Element read_element(Cursor& cur) {
+  Element e;
+  uint32_t word = cur.u32();
+  if (!cur.ok) return e;
+  if (word >> 16) {  // small element: size in the upper half-word
+    e.type = word & 0xffff;
+    e.size = word >> 16;
+    if (e.size > 4) {
+      cur.ok = false;
+      cur.err = "small element larger than 4 bytes";
+      return e;
+    }
+    if (!cur.need(4)) return e;
+    e.data = cur.p + cur.off;
+    cur.off += 4;
+  } else {
+    e.type = word;
+    uint32_t sz = cur.u32();
+    if (!cur.ok) return e;
+    e.size = sz;
+    if (!cur.need(sz)) return e;
+    e.data = cur.p + cur.off;
+    cur.off += sz;
+    // Elements are 8-byte aligned — except compressed ones, which the spec
+    // exempts from padding (back-to-back zlib blocks).
+    if (e.type != miCOMPRESSED) cur.off += (8 - cur.off % 8) % 8;
+  }
+  e.ok = true;
+  return e;
+}
+
+size_t type_size(uint32_t t) {
+  switch (t) {
+    case miINT8: case miUINT8: case miUTF8: return 1;
+    case miINT16: case miUINT16: case miUTF16: return 2;
+    case miINT32: case miUINT32: case miSINGLE: return 4;
+    case miDOUBLE: case miINT64: case miUINT64: return 8;
+    default: return 0;
+  }
+}
+
+bool numeric_to_double(const Element& e, std::vector<double>& out,
+                       std::string& err) {
+  size_t ts = type_size(e.type);
+  if (ts == 0) {
+    err = "unsupported numeric storage type " + std::to_string(e.type);
+    return false;
+  }
+  size_t n = e.size / ts;
+  out.resize(n);
+  for (size_t i = 0; i < n; i++) {
+    const uint8_t* q = e.data + i * ts;
+    switch (e.type) {
+      case miINT8:   out[i] = *reinterpret_cast<const int8_t*>(q); break;
+      case miUINT8:  out[i] = *q; break;
+      case miINT16: { int16_t v; std::memcpy(&v, q, 2); out[i] = v; } break;
+      case miUINT16:{ uint16_t v; std::memcpy(&v, q, 2); out[i] = v; } break;
+      case miINT32: { int32_t v; std::memcpy(&v, q, 4); out[i] = v; } break;
+      case miUINT32:{ uint32_t v; std::memcpy(&v, q, 4); out[i] = v; } break;
+      case miSINGLE:{ float v; std::memcpy(&v, q, 4); out[i] = v; } break;
+      case miDOUBLE:{ double v; std::memcpy(&v, q, 8); out[i] = v; } break;
+      case miINT64: { int64_t v; std::memcpy(&v, q, 8); out[i] = (double)v; } break;
+      case miUINT64:{ uint64_t v; std::memcpy(&v, q, 8); out[i] = (double)v; } break;
+      default: err = "unreachable storage type"; return false;
+    }
+  }
+  return true;
+}
+
+// Decode a char payload into per-codepoint values (column-major order kept).
+bool chars_to_codes(const Element& e, std::vector<uint32_t>& codes,
+                    std::string& err) {
+  codes.clear();
+  if (e.type == miUINT16 || e.type == miUTF16) {
+    size_t n = e.size / 2;
+    codes.reserve(n);
+    for (size_t i = 0; i < n; i++) {
+      uint16_t v;
+      std::memcpy(&v, e.data + 2 * i, 2);
+      codes.push_back(v);  // BMP only; surrogate pairs unsupported (clinical
+                           // variable names are ASCII in practice)
+    }
+    return true;
+  }
+  if (e.type == miUINT8 || e.type == miINT8 || e.type == miUTF8) {
+    codes.assign(e.data, e.data + e.size);  // treat as latin-1/ascii
+    return true;
+  }
+  err = "unsupported char storage type " + std::to_string(e.type);
+  return false;
+}
+
+void append_utf8(std::string& s, uint32_t c) {
+  if (c < 0x80) {
+    s.push_back((char)c);
+  } else if (c < 0x800) {
+    s.push_back((char)(0xC0 | (c >> 6)));
+    s.push_back((char)(0x80 | (c & 0x3F)));
+  } else {
+    s.push_back((char)(0xE0 | (c >> 12)));
+    s.push_back((char)(0x80 | ((c >> 6) & 0x3F)));
+    s.push_back((char)(0x80 | (c & 0x3F)));
+  }
+}
+
+bool parse_matrix(Cursor cur, Var& var, std::string& err);
+
+bool parse_matrix_element(const Element& e, Var& var, std::string& err) {
+  Cursor sub{e.data, e.size};
+  return parse_matrix(sub, var, err);
+}
+
+bool parse_matrix(Cursor cur, Var& var, std::string& err) {
+  Element flags = read_element(cur);
+  if (!flags.ok || flags.type != miUINT32 || flags.size < 8) {
+    err = cur.err.empty() ? "bad array-flags subelement" : cur.err;
+    return false;
+  }
+  uint32_t flagword;
+  std::memcpy(&flagword, flags.data, 4);
+  uint32_t klass = flagword & 0xff;
+
+  Element dims_e = read_element(cur);
+  if (!dims_e.ok || dims_e.type != miINT32) {
+    err = "bad dimensions subelement";
+    return false;
+  }
+  size_t ndim = dims_e.size / 4;
+  var.dims.resize(ndim);
+  size_t total = 1;
+  for (size_t i = 0; i < ndim; i++) {
+    int32_t d;
+    std::memcpy(&d, dims_e.data + 4 * i, 4);
+    var.dims[i] = d;
+    total *= (size_t)d;
+  }
+
+  Element name_e = read_element(cur);
+  if (!name_e.ok) {
+    err = "bad name subelement";
+    return false;
+  }
+  var.name.assign(reinterpret_cast<const char*>(name_e.data), name_e.size);
+  // names are NUL-padded in the small-element form
+  var.name.erase(var.name.find_last_not_of('\0') + 1);
+
+  if (klass >= 6 && klass <= 15) {  // numeric classes
+    Element real = read_element(cur);
+    if (!real.ok) {
+      err = "bad numeric data subelement";
+      return false;
+    }
+    var.kind = 0;
+    if (!numeric_to_double(real, var.data, err)) return false;
+    if (var.data.size() != total) {
+      err = "numeric payload size does not match dims";
+      return false;
+    }
+    return true;
+  }
+  if (klass == mxCHAR) {
+    Element ch = read_element(cur);
+    if (!ch.ok) {
+      err = "bad char data subelement";
+      return false;
+    }
+    std::vector<uint32_t> codes;
+    if (!chars_to_codes(ch, codes, err)) return false;
+    // dims = [rows, cols] column-major: row r's string is codes[r + c*rows]
+    int64_t rows = ndim > 0 ? var.dims[0] : 0;
+    int64_t cols = ndim > 1 ? var.dims[1] : 1;
+    var.kind = 1;
+    for (int64_t r = 0; r < rows; r++) {
+      std::string s;
+      for (int64_t c = 0; c < cols; c++) {
+        size_t idx = (size_t)(r + c * rows);
+        if (idx < codes.size() && codes[idx] != 0) append_utf8(s, codes[idx]);
+      }
+      s.erase(s.find_last_not_of(' ') + 1);  // MATLAB space-pads char rows
+      var.strings.push_back(s);
+    }
+    return true;
+  }
+  if (klass == mxCELL) {
+    var.kind = 2;
+    for (size_t i = 0; i < total; i++) {
+      Element cell = read_element(cur);
+      if (!cell.ok || cell.type != miMATRIX) {
+        err = "bad cell subelement";
+        return false;
+      }
+      Var inner;
+      if (!parse_matrix_element(cell, inner, err)) return false;
+      if (inner.kind != 1) {
+        err = "only cell arrays of char are supported";
+        return false;
+      }
+      var.strings.push_back(inner.strings.empty() ? "" : inner.strings[0]);
+    }
+    return true;
+  }
+  err = "unsupported array class " + std::to_string(klass);
+  return false;
+}
+
+bool inflate_buf(const uint8_t* src, size_t n, std::vector<uint8_t>& out,
+                 std::string& err) {
+  z_stream zs{};
+  if (inflateInit(&zs) != Z_OK) {
+    err = "zlib init failed";
+    return false;
+  }
+  out.resize(n * 4 + 1024);
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = (uInt)n;
+  int ret;
+  size_t written = 0;
+  do {
+    if (written == out.size()) out.resize(out.size() * 2);
+    zs.next_out = out.data() + written;
+    zs.avail_out = (uInt)(out.size() - written);
+    ret = inflate(&zs, Z_NO_FLUSH);
+    written = out.size() - zs.avail_out;
+    if (ret != Z_OK && ret != Z_STREAM_END) {
+      inflateEnd(&zs);
+      err = "zlib inflate error " + std::to_string(ret);
+      return false;
+    }
+  } while (ret != Z_STREAM_END && zs.avail_in > 0);
+  inflateEnd(&zs);
+  out.resize(written);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* matio_open(const char* path, char* errbuf, int errlen) {
+  auto fail = [&](const std::string& msg) -> void* {
+    if (errbuf && errlen > 0) {
+      std::snprintf(errbuf, errlen, "%s", msg.c_str());
+    }
+    return nullptr;
+  };
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return fail(std::string("cannot open ") + path);
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf((size_t)sz);
+  if (std::fread(buf.data(), 1, buf.size(), f) != buf.size()) {
+    std::fclose(f);
+    return fail("short read");
+  }
+  std::fclose(f);
+
+  if (buf.size() < 128) return fail("not a MAT-5 file (too short)");
+  uint16_t version, endian;
+  std::memcpy(&version, buf.data() + 124, 2);
+  std::memcpy(&endian, buf.data() + 126, 2);
+  if (endian != 0x4D49)  // 'IM' little-endian marker
+    return fail("big-endian or non-MAT-5 file unsupported");
+  (void)version;
+
+  auto mf = new MatFile();
+  Cursor cur{buf.data(), buf.size(), 128};
+  std::string err;
+  while (cur.off + 8 <= cur.len) {
+    Element e = read_element(cur);
+    if (!e.ok) {
+      delete mf;
+      return fail(cur.err);
+    }
+    std::vector<uint8_t> inflated;
+    Element payload = e;
+    if (e.type == miCOMPRESSED) {
+      if (!inflate_buf(e.data, e.size, inflated, err)) {
+        delete mf;
+        return fail(err);
+      }
+      Cursor icur{inflated.data(), inflated.size()};
+      payload = read_element(icur);
+      if (!payload.ok) {
+        delete mf;
+        return fail("bad element inside compressed block");
+      }
+    }
+    if (payload.type != miMATRIX) continue;  // skip non-matrix top levels
+    Var v;
+    if (!parse_matrix_element(payload, v, err)) {
+      delete mf;
+      return fail(err);
+    }
+    mf->vars.push_back(std::move(v));
+  }
+  return mf;
+}
+
+int matio_var_count(void* h) { return (int)((MatFile*)h)->vars.size(); }
+
+const char* matio_var_name(void* h, int i) {
+  return ((MatFile*)h)->vars[i].name.c_str();
+}
+
+int matio_var_kind(void* h, int i) { return ((MatFile*)h)->vars[i].kind; }
+
+int matio_var_ndim(void* h, int i) {
+  return (int)((MatFile*)h)->vars[i].dims.size();
+}
+
+void matio_var_dims(void* h, int i, int64_t* out) {
+  const auto& d = ((MatFile*)h)->vars[i].dims;
+  for (size_t k = 0; k < d.size(); k++) out[k] = d[k];
+}
+
+// Column-major doubles; returns element count (call with out=NULL to size).
+int64_t matio_var_doubles(void* h, int i, double* out) {
+  const auto& v = ((MatFile*)h)->vars[i];
+  if (out) std::memcpy(out, v.data.data(), v.data.size() * sizeof(double));
+  return (int64_t)v.data.size();
+}
+
+int matio_var_string_count(void* h, int i) {
+  return (int)((MatFile*)h)->vars[i].strings.size();
+}
+
+const char* matio_var_string(void* h, int i, int j) {
+  return ((MatFile*)h)->vars[i].strings[j].c_str();
+}
+
+void matio_close(void* h) { delete (MatFile*)h; }
+
+}  // extern "C"

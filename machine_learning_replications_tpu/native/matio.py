@@ -1,0 +1,123 @@
+"""ctypes binding + on-demand build of the native MAT-v5 reader.
+
+``read_mat_vars(path, names)`` returns ``{name: ndarray}`` (numeric arrays
+float64 in MATLAB's column-major layout reshaped to numpy row-major view;
+cell/char variables as object arrays of strings), or ``None`` when the
+shared library is unavailable and cannot be built — ``data.matloader``
+falls back to scipy in that case.
+
+The library is compiled once per checkout with g++ (``-O2 -fPIC -lz``)
+into this package directory; a stale object (older than the source) is
+rebuilt. Set ``MLR_TPU_NO_NATIVE=1`` to disable the native path entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "matio.cpp")
+_SO = os.path.join(_HERE, "_matio.so")
+_lock = threading.Lock()
+_lib_cache: list = []  # [lib-or-None] once resolved
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", _SO, "-lz"]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=240
+        )
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    if os.environ.get("MLR_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib_cache:
+            return _lib_cache[0]
+        # A prebuilt .so without the source beside it counts as fresh.
+        fresh = os.path.exists(_SO) and (
+            not os.path.exists(_SRC)
+            or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        )
+        if not fresh and not (os.path.exists(_SRC) and _build()):
+            _lib_cache.append(None)
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _lib_cache.append(None)
+            return None
+        lib.matio_open.restype = ctypes.c_void_p
+        lib.matio_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.matio_var_count.argtypes = [ctypes.c_void_p]
+        lib.matio_var_name.restype = ctypes.c_char_p
+        lib.matio_var_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.matio_var_kind.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.matio_var_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.matio_var_dims.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib.matio_var_doubles.restype = ctypes.c_int64
+        lib.matio_var_doubles.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double)
+        ]
+        lib.matio_var_string_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.matio_var_string.restype = ctypes.c_char_p
+        lib.matio_var_string.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.matio_close.argtypes = [ctypes.c_void_p]
+        _lib_cache.append(lib)
+        return lib
+
+
+def read_mat_vars(path: str, names: list[str]) -> dict[str, np.ndarray] | None:
+    """Read the named variables; raises KeyError if one is missing, returns
+    None if the native backend is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    err = ctypes.create_string_buffer(512)
+    h = lib.matio_open(os.fspath(path).encode(), err, len(err))
+    if not h:
+        raise OSError(err.value.decode() or f"matio: cannot parse {path}")
+    try:
+        found: dict[str, np.ndarray] = {}
+        n = lib.matio_var_count(h)
+        for i in range(n):
+            name = lib.matio_var_name(h, i).decode()
+            if name not in names:
+                continue
+            ndim = lib.matio_var_ndim(h, i)
+            dims = (ctypes.c_int64 * ndim)()
+            lib.matio_var_dims(h, i, dims)
+            shape = tuple(int(d) for d in dims)
+            kind = lib.matio_var_kind(h, i)
+            if kind == 0:  # numeric, column-major payload
+                count = lib.matio_var_doubles(h, i, None)
+                buf = np.empty(int(count), dtype=np.float64)
+                lib.matio_var_doubles(
+                    h, i, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+                )
+                found[name] = buf.reshape(shape, order="F")
+            else:  # char rows or cell-of-strings (column-major cell order)
+                cnt = lib.matio_var_string_count(h, i)
+                vals = [lib.matio_var_string(h, i, j).decode() for j in range(cnt)]
+                arr = np.array(vals, dtype=object)
+                if kind == 2 and arr.size == int(np.prod(shape)):
+                    arr = arr.reshape(shape, order="F")
+                found[name] = arr
+        missing = [nm for nm in names if nm not in found]
+        if missing:
+            raise KeyError(missing[0])
+        return found
+    finally:
+        lib.matio_close(h)
