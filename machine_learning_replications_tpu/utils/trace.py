@@ -1,0 +1,105 @@
+"""Tracing / profiling / numerics-guard subsystem (SURVEY.md §5).
+
+The reference imports ``time`` and never uses it (``train_ensemble_public.py:6``)
+— it has no profiling, tracing, or sanitizer story at all. The TPU build
+supplies:
+
+  * ``PhaseTimer`` — wall-clock accounting per pipeline phase (ingest,
+    impute, select, member fits, …), blocking on device completion so a
+    phase's time is real work, not dispatch. The ≥10× speedup claim in
+    BASELINE.json is measured with these.
+  * ``device_trace`` — ``jax.profiler`` capture around a region, producing
+    a Perfetto/TensorBoard trace directory of on-device timelines.
+  * ``nan_guard`` — opt-in ``jax_debug_nans`` scope, the functional-world
+    stand-in for a race/memory sanitizer: XLA's pure semantics make data
+    races structurally absent, so the failure class worth guarding is
+    numerics (SURVEY.md §5 "Race detection").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator
+
+import jax
+
+
+class _Phase:
+    """Handle yielded by ``PhaseTimer.phase`` — lets the body register work
+    to block on before the phase clock stops."""
+
+    def __init__(self) -> None:
+        self._pending: list[Any] = []
+
+    def block(self, x: Any) -> Any:
+        """Register ``x`` (any pytree of arrays) to be ``block_until_ready``-ed
+        when the phase closes, and pass it through."""
+        self._pending.append(x)
+        return x
+
+
+class PhaseTimer:
+    """Accumulates named phase durations; phases may repeat (times sum).
+
+    JAX dispatch is asynchronous, so a phase's exit blocks on everything the
+    body registered via the yielded handle — the recorded time is real
+    device work, not dispatch:
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("fit") as ph:
+    ...     result = ph.block(train())
+    >>> print(t.report())
+    """
+
+    def __init__(self) -> None:
+        self.seconds: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[_Phase]:
+        ph = _Phase()
+        t0 = time.perf_counter()
+        try:
+            yield ph
+        finally:
+            for x in ph._pending:
+                jax.block_until_ready(x)
+            dt = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> str:
+        total = sum(self.seconds.values())
+        lines = [f"{'phase':<24} {'calls':>5} {'seconds':>10} {'share':>7}"]
+        for name, s in sorted(self.seconds.items(), key=lambda kv: -kv[1]):
+            share = s / total if total else 0.0
+            lines.append(
+                f"{name:<24} {self.counts[name]:>5d} {s:>10.4f} {share:>6.1%}"
+            )
+        lines.append(f"{'total':<24} {'':>5} {total:>10.4f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture an on-device profiler trace (view with Perfetto/TensorBoard)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def nan_guard(enable: bool = True) -> Iterator[None]:
+    """Raise on the first NaN produced inside the scope (jax_debug_nans)."""
+    if not enable:
+        yield
+        return
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

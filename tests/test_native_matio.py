@@ -1,0 +1,85 @@
+"""Native C++ MAT-v5 reader vs the scipy oracle (SURVEY.md §2.4 row
+"scipy.io.loadmat"). Skips when the toolchain can't produce the library."""
+
+import numpy as np
+import pytest
+import scipy.io as sio
+
+from machine_learning_replications_tpu.data import load_data, make_cohort, save_data
+from machine_learning_replications_tpu.native import matio
+
+
+@pytest.fixture(scope="module")
+def native_available():
+    if matio.read_mat_vars.__module__ and matio._load() is None:
+        pytest.skip("native matio library unavailable (no toolchain)")
+    return True
+
+
+def test_matches_scipy_plain_and_compressed(tmp_path, native_available):
+    X, y, names = make_cohort(n=64, seed=7)
+    plain = tmp_path / "plain.mat"
+    save_data(str(plain), X, y, names)
+    comp = tmp_path / "comp.mat"
+    sio.savemat(
+        str(comp),
+        {"data_tb": np.hstack([X, y[:, None]]), "clin_var_names": names},
+        do_compression=True,
+    )
+    ref = sio.loadmat(str(plain))
+    for path in (plain, comp):
+        out = matio.read_mat_vars(str(path), ["data_tb", "clin_var_names"])
+        np.testing.assert_array_equal(out["data_tb"], ref["data_tb"])
+        assert out["clin_var_names"].shape == ref["clin_var_names"].shape
+        assert list(out["clin_var_names"][0]) == [
+            str(s[0]) for s in ref["clin_var_names"][0]
+        ]
+
+
+def test_numeric_storage_type_promotion(tmp_path, native_available):
+    """MATLAB stores small-valued doubles in narrow int types; all must
+    promote to float64 exactly."""
+    arrs = {
+        "data_tb": np.arange(12, dtype=np.float64).reshape(3, 4),
+        "clin_var_names": np.array([["a", "bb", "ccc"]], dtype=object),
+    }
+    p = tmp_path / "narrow.mat"
+    sio.savemat(str(p), arrs)  # scipy narrows integral doubles on write
+    out = matio.read_mat_vars(str(p), ["data_tb", "clin_var_names"])
+    np.testing.assert_array_equal(out["data_tb"], arrs["data_tb"])
+    assert out["data_tb"].dtype == np.float64
+
+
+def test_fortran_order_roundtrip(tmp_path, native_available):
+    """Column-major payload must come back as the original row-major view."""
+    X = np.arange(20, dtype=np.float64).reshape(4, 5)
+    p = tmp_path / "f.mat"
+    sio.savemat(str(p), {"data_tb": X, "clin_var_names": np.array([["x"]], object)})
+    out = matio.read_mat_vars(str(p), ["data_tb"])
+    np.testing.assert_array_equal(out["data_tb"], X)
+
+
+def test_missing_variable_raises(tmp_path, native_available):
+    p = tmp_path / "m.mat"
+    sio.savemat(str(p), {"other": np.ones((2, 2))})
+    with pytest.raises(KeyError):
+        matio.read_mat_vars(str(p), ["data_tb"])
+
+
+def test_not_a_mat_file(tmp_path, native_available):
+    p = tmp_path / "garbage.mat"
+    p.write_bytes(b"this is not a mat file")
+    with pytest.raises(OSError):
+        matio.read_mat_vars(str(p), ["data_tb"])
+
+
+def test_load_data_backend_equivalence(tmp_path, native_available):
+    X, y, names = make_cohort(n=40, seed=3, missing_rate=0.05)
+    p = tmp_path / "c.mat"
+    save_data(str(p), X, y, names)
+    Xn, yn, _ = load_data(str(p), backend="native")
+    Xs, ys, _ = load_data(str(p), backend="scipy")
+    np.testing.assert_array_equal(
+        np.asarray(Xn, dtype=np.float64), np.asarray(Xs, dtype=np.float64)
+    )
+    np.testing.assert_array_equal(yn, ys)

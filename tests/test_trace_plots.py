@@ -1,0 +1,53 @@
+"""Tracing/profiling utilities and host-side plotting (SURVEY.md §5)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.utils import plots, trace
+
+
+def test_phase_timer_accumulates():
+    t = trace.PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b") as ph:
+        out = ph.block(jnp.ones(4) * 2)  # blocked on at phase exit
+    assert float(out.sum()) == 8.0
+    assert t.counts == {"a": 2, "b": 1}
+    assert all(s >= 0 for s in t.seconds.values())
+    rep = t.report()
+    assert "a" in rep and "b" in rep and "total" in rep
+
+
+def test_nan_guard_raises():
+    with pytest.raises(FloatingPointError):
+        with trace.nan_guard():
+            jnp.log(jnp.zeros(2) - 1.0).block_until_ready()
+    # config restored
+    import jax
+
+    assert not jax.config.jax_debug_nans
+
+
+def test_device_trace_writes(tmp_path):
+    with trace.device_trace(str(tmp_path)):
+        jnp.ones(8).sum().block_until_ready()
+    # profiler emits a plugins/profile/<ts>/ tree
+    found = [p for p, _, fs in os.walk(tmp_path) for f in fs]
+    assert found, "no trace output written"
+
+
+def test_roc_pr_figures(tmp_path, rng):
+    y = (rng.uniform(size=200) < 0.3).astype(np.float64)
+    s = np.clip(y * 0.6 + rng.normal(scale=0.3, size=200), 0, 1)
+    roc_p = tmp_path / "roc.png"
+    pr_p = tmp_path / "pr.png"
+    plots.roc_figure(y, s, out_path=roc_p)
+    plots.pr_figure(y, s, out_path=pr_p)
+    assert roc_p.stat().st_size > 1000
+    assert pr_p.stat().st_size > 1000
