@@ -1,24 +1,26 @@
 #!/usr/bin/env python
-"""Driver benchmark: GBDT-ensemble train wall-clock, TPU vs single-CPU sklearn.
+"""Driver benchmark harness — the five BASELINE.json configs as named entry
+points. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Prints ONE JSON line:
-  {"metric": ..., "value": <tpu seconds>, "unit": "s", "vs_baseline": <speedup>}
+Configs (``--config``, default 3 — the driver-recorded headline):
+  1  single-patient stacked inference, shipped-pickle weights
+     (``predict_hf.py`` flow; baseline = closed-form numpy on host CPU)
+  2  single decision tree on the HF cohort
+     (``GradientBoostingClassifier(n_estimators=1, max_depth=1)`` member)
+  3  full 100-stump GradientBoosting ensemble (``train_ensemble_public.py:45``)
+  4  5-fold CV sweep over the n_estimators × max_depth grid
+     (baseline = sklearn ``GridSearchCV``)
+  5  scaled synthetic cohort (default 10M rows), 256-bin hist splitter
+     (baseline = sklearn on a subsample, linearly extrapolated — an
+     *underestimate* of sklearn's true n·log n cost, so the reported
+     speedup is conservative)
 
-The workload is BASELINE.json config 3 — the reference's
-``GradientBoostingClassifier(n_estimators=100, max_depth=1, random_state=2020)``
-(``train_ensemble_public.py:45``) — on a Table-S1-matched synthetic cohort
-(the reference ships no data; SURVEY.md §6), scaled to ``--rows`` rows
-(default 200k, per config 5's scaled-cohort direction). The baseline is
-sklearn fitting the identical estimator on the identical matrix on this
-host's CPU. ``vs_baseline`` is the wall-clock speedup (baseline / ours);
-the run also checks AUC-ROC parity within ±0.005 (BASELINE.json budget)
-and fails loudly if violated.
-
-Timing protocol: one compile/warmup fit first (XLA traces once), then the
-median of ``--repeats`` end-to-end fits — each timed fit includes host-side
-quantile binning, host→device transfer, and the full 100-stage boosting
-loop on device (``jax.block_until_ready``). The sklearn baseline is the
-median of ``--cpu-repeats`` fits.
+The workload data is the Table-S1-matched synthetic cohort (the reference
+ships no data; SURVEY.md §6). Every training config checks AUC-ROC parity
+with sklearn within ±0.005 (BASELINE.json budget) and fails loudly if
+violated. Timing: one warmup (XLA compiles once), then the median of
+``--repeats`` end-to-end runs, each blocking on device completion.
 """
 
 from __future__ import annotations
@@ -31,91 +33,305 @@ import time
 import warnings
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=200_000)
-    ap.add_argument("--repeats", type=int, default=3)
-    ap.add_argument("--cpu-repeats", type=int, default=1)
-    ap.add_argument(
-        "--splitter", choices=("exact", "hist"), default="exact",
-        help="split search: 'exact' enumerates every unique-value midpoint "
-        "(sklearn BestSplitter semantics); 'hist' caps candidates at 256 "
-        "quantile bins (the scalable approximate path)",
-    )
-    args = ap.parse_args()
+def _median_time(fn, repeats: int, *, warmup: bool = True) -> float:
+    """Median wall-clock of ``repeats`` calls. ``warmup`` runs one untimed
+    call first (XLA compile); CPU sklearn baselines pass ``warmup=False`` —
+    there is nothing to warm and the fits dominate the harness runtime."""
+    if warmup:
+        fn()
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
 
-    warnings.filterwarnings("ignore")
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload))
+
+
+def _cohort(rows: int, seed: int = 2020):
+    import numpy as np
+
+    from machine_learning_replications_tpu.data import make_cohort
+    from machine_learning_replications_tpu.data.schema import selected_indices
+
+    X, y, _ = make_cohort(n=rows, seed=seed)
+    X17 = np.ascontiguousarray(X[:, selected_indices()], dtype=np.float32)
+    return X17, np.asarray(y), np.asarray(y, dtype=np.float32)
+
+
+def bench_inference(args) -> None:
+    """Config 1: the predict_hf.py flow — stacked predict_proba from the
+    shipped pickle's decoded weights, one patient + a batch."""
     import jax
     import numpy as np
 
+    from machine_learning_replications_tpu.data.examples import patient_row
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.persist import (
+        REFERENCE_PKL_PATH,
+        decode_pickle,
+        import_stacking,
+    )
+
+    params = import_stacking(decode_pickle(REFERENCE_PKL_PATH))
+    x1 = patient_row().reshape(1, -1)
+
+    predict = jax.jit(stacking.predict_proba1)
+
+    def device_once():
+        jax.block_until_ready(predict(params, x1))
+
+    tpu_s = _median_time(device_once, args.repeats * 10)
+
+    # Baseline: the same closed-form math (SURVEY.md §3.4) in numpy on host —
+    # the modern stand-in for the reference's sklearn-0.23 predict path,
+    # which current sklearn cannot execute from the shipped pickle.
+    np_params = jax.tree.map(np.asarray, params)
+
+    def host_once():
+        _numpy_stacked_predict(np_params, x1)
+
+    cpu_s = _median_time(host_once, args.repeats * 10)
+
+    prob = float(predict(params, x1)[0])
+    _emit({
+        "metric": "stacked_inference_latency_1patient",
+        "value": round(tpu_s * 1e3, 4),
+        "unit": "ms",
+        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "baseline_ms": round(cpu_s * 1e3, 4),
+        "probability_pct": round(100 * prob, 2),
+        "device": _device_kind(),
+    })
+
+
+def _numpy_stacked_predict(p, X):
+    import numpy as np
+
+    Xs = (X - p.scaler.mean) / p.scaler.scale
+    d2 = (
+        (Xs * Xs).sum(1)[:, None]
+        + (p.svc.support_vectors * p.svc.support_vectors).sum(1)[None, :]
+        - 2.0 * Xs @ p.svc.support_vectors.T
+    )
+    dec = np.exp(-p.svc.gamma * d2) @ p.svc.dual_coef.ravel() + p.svc.intercept
+    p_svc = 1.0 / (1.0 + np.exp(p.svc.prob_a * dec + p.svc.prob_b))
+    t = p.gbdt
+    idx = np.zeros(X.shape[0], dtype=np.int64)
+    total = np.zeros(X.shape[0])
+    for ti in range(t.feature.shape[0]):
+        idx[:] = 0
+        for _ in range(t.max_depth):
+            f = np.asarray(t.feature)[ti, idx]
+            go_left = X[np.arange(X.shape[0]), f] <= np.asarray(t.threshold)[ti, idx]
+            idx = np.where(go_left, np.asarray(t.left)[ti, idx], np.asarray(t.right)[ti, idx])
+        total += np.asarray(t.value)[ti, idx]
+    p_gbc = 1.0 / (1.0 + np.exp(-(float(t.init_raw) + float(t.learning_rate) * total)))
+    z = X @ np.asarray(p.logreg.coef).ravel() + float(p.logreg.intercept)
+    p_lg = 1.0 / (1.0 + np.exp(-z))
+    meta = np.stack([p_svc, p_gbc, p_lg], axis=1)
+    zm = meta @ np.asarray(p.meta.coef).ravel() + float(p.meta.intercept)
+    return 1.0 / (1.0 + np.exp(-zm))
+
+
+def bench_gbdt(args, n_estimators: int, metric: str) -> None:
+    """Configs 2 & 3: the reference's exact GBDT estimator vs sklearn."""
+    import jax
+
     from machine_learning_replications_tpu.config import GBDTConfig
-    from machine_learning_replications_tpu.data import make_cohort
-    from machine_learning_replications_tpu.data.schema import selected_indices
     from machine_learning_replications_tpu.models import gbdt, tree
     from machine_learning_replications_tpu.utils import metrics
 
-    device = jax.devices()[0]
-    X, y, _ = make_cohort(n=args.rows, seed=2020)
-    X17 = np.ascontiguousarray(X[:, selected_indices()], dtype=np.float32)
-    yf = np.asarray(y, dtype=np.float32)
+    X17, y, yf = _cohort(args.rows)
 
-    # --- CPU sklearn baseline (the reference's exact estimator) -----------
     from sklearn.ensemble import GradientBoostingClassifier
 
-    cpu_times = []
-    for _ in range(args.cpu_repeats):
-        t0 = time.perf_counter()
-        sk = GradientBoostingClassifier(
-            n_estimators=100, max_depth=1, random_state=2020
-        ).fit(X17, y)
-        cpu_times.append(time.perf_counter() - t0)
-    cpu_s = statistics.median(cpu_times)
-    auc_sk = float(metrics.roc_auc(y, sk.predict_proba(X17)[:, 1]))
+    sk_holder = {}
 
-    # --- TPU-native fit ---------------------------------------------------
-    cfg = GBDTConfig(splitter=args.splitter)
+    def cpu_fit():
+        sk_holder["m"] = GradientBoostingClassifier(
+            n_estimators=n_estimators, max_depth=1, random_state=2020
+        ).fit(X17, y)
+
+    cpu_s = _median_time(cpu_fit, args.cpu_repeats, warmup=False)
+    auc_sk = float(metrics.roc_auc(y, sk_holder["m"].predict_proba(X17)[:, 1]))
+
+    cfg = GBDTConfig(splitter=args.splitter, n_estimators=n_estimators)
+    holder = {}
 
     def tpu_fit():
         params, _ = gbdt.fit(X17, yf, cfg)
         jax.block_until_ready(params.value)
-        return params
+        holder["params"] = params
 
-    tpu_fit()  # compile + warm caches
-    tpu_times = []
-    for _ in range(args.repeats):
-        t0 = time.perf_counter()
-        params = tpu_fit()
-        tpu_times.append(time.perf_counter() - t0)
-    tpu_s = statistics.median(tpu_times)
-    auc_tpu = float(metrics.roc_auc(y, tree.predict_proba1(params, X17)))
+    tpu_s = _median_time(tpu_fit, args.repeats)
+    auc_tpu = float(metrics.roc_auc(y, tree.predict_proba1(holder["params"], X17)))
+    _check_parity(auc_tpu, auc_sk)
 
-    auc_delta = abs(auc_tpu - auc_sk)
-    if auc_delta > 0.005:
+    print(
+        f"rows={args.rows} device={_device_kind()} "
+        f"sklearn_cpu={cpu_s:.3f}s tpu={tpu_s:.3f}s "
+        f"auc sklearn={auc_sk:.6f} tpu={auc_tpu:.6f}",
+        file=sys.stderr,
+    )
+    _emit({
+        "metric": metric,
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "baseline_wall_s": round(cpu_s, 4),
+        "auc_delta_vs_sklearn": round(abs(auc_tpu - auc_sk), 8),
+        "device": _device_kind(),
+    })
+
+
+def bench_sweep(args) -> None:
+    """Config 4: the CV grid sweep vs sklearn GridSearchCV."""
+    from machine_learning_replications_tpu.config import SweepConfig
+    from machine_learning_replications_tpu.models import sweep as sweep_mod
+
+    X17, y, yf = _cohort(args.rows)
+    grid_est = (25, 50, 100)
+    grid_depth = (1, 2, 3)
+    cfg = SweepConfig(
+        n_estimators_grid=grid_est, max_depth_grid=grid_depth, cv_folds=5
+    )
+
+    holder = {}
+
+    def ours():
+        holder["res"] = sweep_mod.cv_sweep(X17, yf, cfg)
+
+    tpu_s = _median_time(ours, args.repeats)
+    res = holder["res"]
+
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.model_selection import GridSearchCV
+
+    sk_holder = {}
+
+    def sk_fit():
+        sk_holder["gs"] = GridSearchCV(
+            GradientBoostingClassifier(random_state=2020),
+            {"n_estimators": list(grid_est), "max_depth": list(grid_depth)},
+            scoring="roc_auc",
+            cv=5,
+        ).fit(X17, y)
+
+    cpu_s = _median_time(sk_fit, args.cpu_repeats, warmup=False)
+    gs = sk_holder["gs"]
+    _check_parity(res.best_mean_auc, float(gs.best_score_))
+
+    _emit({
+        "metric": f"cv_sweep_{len(grid_est)}x{len(grid_depth)}_grid_{args.rows}rows",
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "baseline_wall_s": round(cpu_s, 4),
+        "best_auc_delta": round(abs(res.best_mean_auc - float(gs.best_score_)), 8),
+        "device": _device_kind(),
+    })
+
+
+def bench_scaled(args) -> None:
+    """Config 5: scaled cohort, hist splitter. Baseline extrapolated from a
+    sklearn fit on ``--baseline-rows`` (linear in n — conservative for the
+    baseline's true n·log n growth)."""
+    import jax
+
+    from machine_learning_replications_tpu.config import GBDTConfig
+    from machine_learning_replications_tpu.models import gbdt, tree
+    from machine_learning_replications_tpu.utils import metrics
+
+    rows = args.rows if args.rows is not None else 10_000_000
+    X17, y, yf = _cohort(rows)
+
+    cfg = GBDTConfig(splitter="hist", n_bins=256)
+    holder = {}
+
+    def tpu_fit():
+        params, _ = gbdt.fit(X17, yf, cfg)
+        jax.block_until_ready(params.value)
+        holder["params"] = params
+
+    tpu_s = _median_time(tpu_fit, args.repeats)
+    auc_tpu = float(metrics.roc_auc(y, tree.predict_proba1(holder["params"], X17)))
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    nb = min(args.baseline_rows, rows)
+    t0 = time.perf_counter()
+    sk = GradientBoostingClassifier(
+        n_estimators=100, max_depth=1, random_state=2020
+    ).fit(X17[:nb], y[:nb])
+    cpu_sub_s = time.perf_counter() - t0
+    cpu_s = cpu_sub_s * (rows / nb)
+    auc_sk = float(metrics.roc_auc(y, sk.predict_proba(X17)[:, 1]))
+    _check_parity(auc_tpu, auc_sk)
+
+    _emit({
+        "metric": f"gbdt100_hist_train_{rows}rows",
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "baseline_wall_s_extrapolated": round(cpu_s, 2),
+        "baseline_measured_rows": nb,
+        "throughput_rows_per_s": round(rows / tpu_s, 1),
+        "auc_delta_vs_sklearn": round(abs(auc_tpu - auc_sk), 8),
+        "device": _device_kind(),
+    })
+
+
+def _check_parity(auc_ours: float, auc_sk: float) -> None:
+    if abs(auc_ours - auc_sk) > 0.005:
         print(
-            f"FAIL: AUC parity violated: tpu={auc_tpu:.6f} sklearn={auc_sk:.6f}",
+            f"FAIL: AUC parity violated: ours={auc_ours:.6f} sklearn={auc_sk:.6f}",
             file=sys.stderr,
         )
         sys.exit(1)
 
-    print(
-        f"rows={args.rows} device={device.device_kind} "
-        f"sklearn_cpu={cpu_s:.3f}s tpu={tpu_s:.3f}s "
-        f"auc sklearn={auc_sk:.6f} tpu={auc_tpu:.6f} (|Δ|={auc_delta:.2e})",
-        file=sys.stderr,
+
+def _device_kind() -> str:
+    import jax
+
+    return str(jax.devices()[0].device_kind)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", type=int, choices=(1, 2, 3, 4, 5), default=3)
+    ap.add_argument(
+        "--rows", type=int, default=None,
+        help="cohort rows (default: 200k for configs 1-4, 10M for config 5)",
     )
-    print(
-        json.dumps(
-            {
-                "metric": f"gbdt100_train_wall_clock_{args.rows}rows",
-                "value": round(tpu_s, 4),
-                "unit": "s",
-                "vs_baseline": round(cpu_s / tpu_s, 3),
-                "baseline_wall_s": round(cpu_s, 4),
-                "auc_delta_vs_sklearn": round(auc_delta, 8),
-                "device": str(device.device_kind),
-            }
-        )
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--cpu-repeats", type=int, default=1)
+    ap.add_argument("--baseline-rows", type=int, default=200_000,
+                    help="config 5: sklearn baseline subsample size")
+    ap.add_argument(
+        "--splitter", choices=("exact", "hist"), default="exact",
+        help="split search for configs 2-3: 'exact' enumerates every "
+        "unique-value midpoint (sklearn BestSplitter semantics); 'hist' "
+        "caps candidates at 256 quantile bins",
     )
+    args = ap.parse_args()
+    warnings.filterwarnings("ignore")
+    if args.rows is None and args.config != 5:
+        args.rows = 200_000
+
+    if args.config == 1:
+        bench_inference(args)
+    elif args.config == 2:
+        bench_gbdt(args, 1, f"single_stump_train_{args.rows}rows")
+    elif args.config == 3:
+        bench_gbdt(args, 100, f"gbdt100_train_wall_clock_{args.rows}rows")
+    elif args.config == 4:
+        bench_sweep(args)
+    else:
+        bench_scaled(args)
 
 
 if __name__ == "__main__":

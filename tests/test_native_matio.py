@@ -11,7 +11,7 @@ from machine_learning_replications_tpu.native import matio
 
 @pytest.fixture(scope="module")
 def native_available():
-    if matio.read_mat_vars.__module__ and matio._load() is None:
+    if matio._load() is None:
         pytest.skip("native matio library unavailable (no toolchain)")
     return True
 
@@ -36,17 +36,43 @@ def test_matches_scipy_plain_and_compressed(tmp_path, native_available):
         ]
 
 
-def test_numeric_storage_type_promotion(tmp_path, native_available):
-    """MATLAB stores small-valued doubles in narrow int types; all must
-    promote to float64 exactly."""
-    arrs = {
-        "data_tb": np.arange(12, dtype=np.float64).reshape(3, 4),
-        "clin_var_names": np.array([["a", "bb", "ccc"]], dtype=object),
-    }
-    p = tmp_path / "narrow.mat"
-    sio.savemat(str(p), arrs)  # scipy narrows integral doubles on write
-    out = matio.read_mat_vars(str(p), ["data_tb", "clin_var_names"])
-    np.testing.assert_array_equal(out["data_tb"], arrs["data_tb"])
+def _mat5_numeric(name: bytes, mi_type: int, payload: bytes, dims=(2, 3),
+                  mx_class: int = 6) -> bytes:
+    """Hand-craft a minimal MAT-5 file with one numeric miMATRIX whose data
+    subelement uses storage type ``mi_type`` (MATLAB narrows integral
+    doubles on write; scipy does not, so this path must be built by hand)."""
+    import struct
+
+    def element(t, data):
+        pad = (8 - len(data) % 8) % 8
+        return struct.pack("<II", t, len(data)) + data + b"\0" * pad
+
+    flags = element(6, struct.pack("<II", mx_class, 0))          # miUINT32 ×2
+    dim_e = element(5, struct.pack("<ii", *dims))                # miINT32
+    name_e = element(1, name)                                    # miINT8
+    data_e = element(mi_type, payload)
+    matrix = element(14, flags + dim_e + name_e + data_e)
+    header = b"MATLAB 5.0 MAT-file, handcrafted".ljust(124) + struct.pack(
+        "<HH", 0x0100, 0x4D49
+    )
+    return header + matrix
+
+
+@pytest.mark.parametrize(
+    "mi_type,np_dtype",
+    [(1, np.int8), (2, np.uint8), (3, np.int16), (4, np.uint16),
+     (5, np.int32), (7, np.float32), (9, np.float64)],
+)
+def test_numeric_storage_type_promotion(tmp_path, native_available, mi_type, np_dtype):
+    """Every storage type MATLAB may narrow doubles into must promote back
+    to exact float64 (column-major payload)."""
+    vals = np.array([[0, 1, 2], [3, 4, 5]], dtype=np_dtype)
+    p = tmp_path / f"narrow{mi_type}.mat"
+    p.write_bytes(
+        _mat5_numeric(b"data_tb", mi_type, vals.tobytes(order="F"))
+    )
+    out = matio.read_mat_vars(str(p), ["data_tb"])
+    np.testing.assert_array_equal(out["data_tb"], vals.astype(np.float64))
     assert out["data_tb"].dtype == np.float64
 
 
