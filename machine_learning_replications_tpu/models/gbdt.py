@@ -387,6 +387,82 @@ def _binned_init(thresholds: jnp.ndarray, y: jnp.ndarray, n_stages: int, depth: 
     )
 
 
+def resolve_hist_fn(backend: str):
+    """Histogram-statistics implementation for a resolved backend name."""
+    if backend == "pallas":
+        from machine_learning_replications_tpu.ops.pallas_histogram import (
+            node_histograms_pallas,
+        )
+
+        return node_histograms_pallas
+    return histogram.node_histograms
+
+
+def make_tree_grower(
+    binned: jnp.ndarray,      # [n_local, F] int32
+    thresholds: jnp.ndarray,  # [F, B-1]
+    *,
+    depth: int,
+    max_bins: int,
+    min_samples_split: int,
+    min_samples_leaf: int,
+    hist_fn,
+    node_init: jnp.ndarray | None = None,  # [n_local] int32, −1 ⇒ inactive row
+    reduce_fn=lambda a: a,    # cross-shard reduction (lax.psum in shard_map)
+):
+    """Build the level-synchronous tree-growth step shared by the
+    single-device trainer and the sharded trainer (``parallel.hist_trainer``)
+    — one copy of the split bookkeeping, routing, and Newton-leaf math; the
+    sharded caller differs only in ``reduce_fn`` (histogram/leaf partials
+    psum'd over the data axis) and ``node_init`` (padding rows parked at −1).
+
+    Returns ``grow_tree(g, h) -> (feat_t, thr_t, val_t, split_t, node)``.
+    """
+    n, F = binned.shape
+    NN = 2 ** (depth + 1) - 1
+    dtype = thresholds.dtype
+    rows = jnp.arange(n)
+    if node_init is None:
+        node_init = jnp.zeros(n, jnp.int32)
+
+    def grow_tree(g, h):
+        node = node_init
+        feat_t = jnp.zeros(NN, jnp.int32)
+        thr_t = jnp.full(NN, jnp.inf, dtype)
+        split_t = jnp.zeros(NN, bool)
+        for level in range(depth):
+            base = 2**level - 1
+            K = 2**level
+            node_local = jnp.where(node >= base, node - base, -1)
+            hl = hist_fn(binned, node_local, g, h, K, max_bins)
+            hists = histogram.NodeHistograms(*(reduce_fn(a) for a in hl))
+            sp = histogram.best_splits(
+                hists, thresholds, min_samples_split, min_samples_leaf
+            )
+            feat_t = jax.lax.dynamic_update_slice(
+                feat_t, jnp.where(sp.do_split, sp.feature, 0), (base,)
+            )
+            thr_t = jax.lax.dynamic_update_slice(
+                thr_t, jnp.where(sp.do_split, sp.threshold, jnp.inf).astype(dtype), (base,)
+            )
+            split_t = jax.lax.dynamic_update_slice(split_t, sp.do_split, (base,))
+            # Route rows of split nodes to their children; others park.
+            k = jnp.maximum(node_local, 0)
+            splits_here = (node_local >= 0) & sp.do_split[k]
+            go_left = binned[rows, sp.feature[k]] <= sp.boundary[k]
+            child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+            node = jnp.where(splits_here, child, node)
+        # Newton leaf values over final row positions (inactive rows → dump
+        # segment NN, which is dropped)
+        seg = jnp.where(node >= 0, node, NN)
+        num = reduce_fn(jax.ops.segment_sum(g, seg, num_segments=NN + 1)[:NN])
+        den = reduce_fn(jax.ops.segment_sum(h, seg, num_segments=NN + 1)[:NN])
+        val_t = histogram.newton_leaf_value(num, den)
+        return feat_t, thr_t, val_t, split_t, node
+
+    return grow_tree
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -409,50 +485,15 @@ def _run_binned(
     min_samples_leaf: int,
     backend: str = "xla",
 ):
-    if backend == "pallas":
-        from machine_learning_replications_tpu.ops.pallas_histogram import (
-            node_histograms_pallas as hist_fn,
-        )
-    else:
-        hist_fn = histogram.node_histograms
-    n, F = binned.shape
-    NN = 2 ** (depth + 1) - 1
     dtype = thresholds.dtype
     yf = y.astype(dtype)
-    rows = jnp.arange(n)
-
-    def grow_tree(g, h):
-        """One stage's tree: level-synchronous growth over static depth."""
-        node = jnp.zeros(n, jnp.int32)
-        feat_t = jnp.zeros(NN, jnp.int32)
-        thr_t = jnp.full(NN, jnp.inf, dtype)
-        split_t = jnp.zeros(NN, bool)
-        for level in range(depth):
-            base = 2**level - 1
-            K = 2**level
-            node_local = jnp.where(node >= base, node - base, -1)
-            hists = hist_fn(binned, node_local, g, h, K, max_bins)
-            sp = histogram.best_splits(
-                hists, thresholds, min_samples_split, min_samples_leaf
-            )
-            feat_t = jax.lax.dynamic_update_slice(
-                feat_t, jnp.where(sp.do_split, sp.feature, 0), (base,)
-            )
-            thr_t = jax.lax.dynamic_update_slice(
-                thr_t, jnp.where(sp.do_split, sp.threshold, jnp.inf).astype(dtype), (base,)
-            )
-            split_t = jax.lax.dynamic_update_slice(split_t, sp.do_split, (base,))
-            # Route rows of split nodes to their children; others park.
-            k = jnp.maximum(node_local, 0)
-            splits_here = (node_local >= 0) & sp.do_split[k]
-            go_left = binned[rows, sp.feature[k]] <= sp.boundary[k]
-            child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
-            node = jnp.where(splits_here, child, node)
-        # Newton leaf values over final row positions
-        num = jax.ops.segment_sum(g, node, num_segments=NN)
-        den = jax.ops.segment_sum(h, node, num_segments=NN)
-        val_t = histogram.newton_leaf_value(num, den)
-        return feat_t, thr_t, val_t, split_t, node
+    grow_tree = make_tree_grower(
+        binned, thresholds,
+        depth=depth, max_bins=max_bins,
+        min_samples_split=min_samples_split,
+        min_samples_leaf=min_samples_leaf,
+        hist_fn=resolve_hist_fn(backend),
+    )
 
     def stage(t, carry):
         raw, feats, thrs, vals, splits, devs = carry

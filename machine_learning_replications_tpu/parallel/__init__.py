@@ -19,5 +19,18 @@ from machine_learning_replications_tpu.parallel.mesh import (
     make_mesh,
     single_device_mesh,
 )
+from machine_learning_replications_tpu.parallel import (
+    distributed,
+    hist_trainer,
+    stump_trainer,
+)
 
-__all__ = ["DATA_AXIS", "MODEL_AXIS", "make_mesh", "single_device_mesh"]
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "single_device_mesh",
+    "distributed",
+    "hist_trainer",
+    "stump_trainer",
+]

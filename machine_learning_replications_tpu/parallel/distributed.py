@@ -1,0 +1,84 @@
+"""Multi-host bring-up — the distributed communication backend (SURVEY.md §5).
+
+The reference has no communication layer at all (§2.5: no NCCL/MPI/Gloo,
+single process). The TPU-native equivalent is JAX's built-in runtime:
+``jax.distributed.initialize`` connects the hosts of a pod slice (or
+several slices over DCN), after which ``jax.devices()`` spans every chip
+and the framework's meshes/collectives (``mesh.make_mesh`` + psum/
+all_gather inside ``shard_map``) ride ICI within a slice and DCN across
+slices — XLA emits the transport; nothing NCCL-like is hand-rolled here.
+
+On a single host this module is a no-op: every entry point degrades to
+local devices.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from machine_learning_replications_tpu.parallel.mesh import make_mesh
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    *,
+    auto: bool = True,
+) -> bool:
+    """Connect this host to the distributed runtime.
+
+    Explicit arguments default to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``).
+    When neither arguments nor env vars are present and ``auto`` is True,
+    ``jax.distributed.initialize()`` is attempted with no arguments — the
+    Cloud-TPU-pod path, where the runtime discovers all three from TPU
+    metadata; a machine with no cluster environment fails that probe and
+    degrades to the single-host no-op. Returns True when a multi-process
+    runtime was brought up, False for the no-op. Safe to call twice.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    if coordinator_address is None and num_processes is None:
+        if not auto:
+            return False
+        try:
+            jax.distributed.initialize()  # cluster auto-detection
+        except (RuntimeError, ValueError):
+            return False  # no cluster environment: single-host no-op
+        _initialized = True
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def global_mesh(data: int | None = None, model: int = 1):
+    """A mesh over every device the runtime can see (all hosts after
+    ``initialize_distributed``; the local chip(s) otherwise)."""
+    return make_mesh(data=data, model=model, devices=jax.devices())
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) of this host."""
+    return jax.process_index(), jax.process_count()

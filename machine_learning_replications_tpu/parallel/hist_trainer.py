@@ -1,0 +1,176 @@
+"""Distributed level-wise GBDT training (any depth) over the data axis.
+
+The sharded counterpart of ``models.gbdt._fit_binned`` (SURVEY.md §2.5
+"histogram partials all-reduced over ICI"): rows are sharded contiguously
+over the mesh's 'data' axis; each boosting stage grows its tree
+level-synchronously —
+
+  1. every shard builds per-(node, feature, bin) histograms from its local
+     rows (the Pallas MXU kernel on TPU, XLA segment_sum elsewhere);
+  2. one ``psum`` over 'data' replicates the global histograms
+     (``[K, F, B]·4`` floats — the only per-level communication);
+  3. every shard runs the identical friedman split selection and routes its
+     own rows to child nodes.
+
+Leaf Newton values come from a psum'd segment-sum over final node ids, and
+the deviance from psum'd log-likelihood partials — nothing crosses the host
+boundary inside the stage loop. The 'model' axis is left replicated here
+(feature tiling pays off only in the stump layout — ``stump_trainer``);
+outputs are replicated on every shard by construction.
+
+Padding contract: rows appended to even out shards carry weight 0 and node
+−1 forever; their gradients are zeroed so every reduction ignores them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from machine_learning_replications_tpu.config import GBDTConfig
+from machine_learning_replications_tpu.models import gbdt
+from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
+from machine_learning_replications_tpu.ops import binning
+from machine_learning_replications_tpu.parallel.mesh import DATA_AXIS
+
+
+def fit(
+    mesh: jax.sharding.Mesh,
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: GBDTConfig = GBDTConfig(),
+    bins: binning.BinnedFeatures | None = None,
+) -> tuple[TreeEnsembleParams, dict[str, Any]]:
+    """GBDT fit of any depth with rows sharded over ``mesh``'s 'data' axis."""
+    if bins is None:
+        bins = binning.bin_features(np.asarray(X), gbdt.bin_budget(cfg))
+    n_data = mesh.shape[DATA_AXIS]
+    n = bins.binned.shape[0]
+    n_pad = -(-n // n_data) * n_data
+    fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+
+    binned = np.concatenate(
+        [np.asarray(bins.binned, np.int32),
+         np.zeros((n_pad - n, bins.binned.shape[1]), np.int32)]
+    )
+    w = np.concatenate([np.ones(n, fdt), np.zeros(n_pad - n, fdt)])
+    yp = np.concatenate([np.asarray(y, fdt), np.zeros(n_pad - n, fdt)])
+
+    def put(a, spec):
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    feats, thrs, vals, splits, devs = _fit_sharded(
+        mesh,
+        put(binned, P(DATA_AXIS, None)),
+        put(w, P(DATA_AXIS)),
+        put(yp, P(DATA_AXIS)),
+        put(np.asarray(bins.thresholds, fdt), P()),
+        n_stages=cfg.n_estimators,
+        depth=cfg.max_depth,
+        max_bins=bins.max_bins,
+        learning_rate=cfg.learning_rate,
+        min_samples_split=cfg.min_samples_split,
+        min_samples_leaf=cfg.min_samples_leaf,
+        backend=gbdt.resolve_backend(cfg),
+    )
+    params = gbdt.forest_to_params(
+        feats, thrs, vals, splits,
+        init_raw=gbdt._prior_log_odds(y),
+        learning_rate=cfg.learning_rate,
+        max_depth=cfg.max_depth,
+    )
+    return params, {"train_deviance": np.asarray(devs)}
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "n_stages", "depth", "max_bins", "learning_rate",
+        "min_samples_split", "min_samples_leaf", "backend",
+    ),
+)
+def _fit_sharded(
+    mesh,
+    binned,      # [n_pad, F] int32, sharded over 'data'
+    w,           # [n_pad] — 1 real / 0 padding
+    y,           # [n_pad]
+    thresholds,  # [F, B-1] replicated
+    *,
+    n_stages: int,
+    depth: int,
+    max_bins: int,
+    learning_rate: float,
+    min_samples_split: int,
+    min_samples_leaf: int,
+    backend: str,
+):
+    from jax import shard_map
+
+    NN = 2 ** (depth + 1) - 1
+
+    def local_loop(bl, wl, yl, thr):
+        n_loc, F = bl.shape
+        dtype = thr.dtype
+
+        def gsum(v):
+            return jax.lax.psum(jnp.sum(v), DATA_AXIS)
+
+        n_real = gsum(wl)
+        p1 = gsum(yl * wl) / n_real
+        f0 = jnp.log(p1 / (1.0 - p1))
+
+        # One copy of the growth algorithm (models.gbdt.make_tree_grower);
+        # sharding enters only through reduce_fn and the −1-parked padding.
+        grow_tree = gbdt.make_tree_grower(
+            bl, thr,
+            depth=depth, max_bins=max_bins,
+            min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            hist_fn=gbdt.resolve_hist_fn(backend),
+            node_init=jnp.where(wl > 0, 0, -1).astype(jnp.int32),
+            reduce_fn=lambda a: jax.lax.psum(a, DATA_AXIS),
+        )
+
+        def stage(t, carry):
+            raw, feats, thrs_o, vals, splits, devs = carry
+            p = jax.scipy.special.expit(raw)
+            g = (yl - p) * wl
+            h = p * (1.0 - p) * wl
+            feat_t, thr_t, val_t, split_t, node = grow_tree(g, h)
+            raw = raw + learning_rate * val_t[jnp.maximum(node, 0)] * wl
+            ll = gsum((yl * raw - jnp.logaddexp(0.0, raw)) * wl)
+            dev = -2.0 * ll / n_real
+            return (
+                raw,
+                feats.at[t].set(feat_t),
+                thrs_o.at[t].set(thr_t),
+                vals.at[t].set(val_t),
+                splits.at[t].set(split_t),
+                devs.at[t].set(dev),
+            )
+
+        init = (
+            jnp.full(n_loc, f0, dtype),
+            jnp.zeros((n_stages, NN), jnp.int32),
+            jnp.full((n_stages, NN), jnp.inf, dtype),
+            jnp.zeros((n_stages, NN), dtype),
+            jnp.zeros((n_stages, NN), bool),
+            jnp.zeros(n_stages, dtype),
+        )
+        _, feats, thrs_o, vals, splits, devs = jax.lax.fori_loop(
+            0, n_stages, stage, init
+        )
+        return feats, thrs_o, vals, splits, devs
+
+    return shard_map(
+        local_loop,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P(), P(), P()),
+        check_vma=False,
+    )(binned, w, y, thresholds)

@@ -1,0 +1,32 @@
+"""parallel.distributed — single-host no-op contract, env parsing, mesh."""
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.parallel import distributed, DATA_AXIS
+
+
+def test_single_host_noop(monkeypatch):
+    """No args, no env vars, auto disabled: must be a clean no-op."""
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.initialize_distributed(auto=False) is False
+
+
+def test_env_var_parsing_malformed(monkeypatch):
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "not-a-number")
+    with pytest.raises(ValueError):
+        distributed.initialize_distributed(auto=False)
+
+
+def test_process_info_single_host():
+    idx, count = distributed.process_info()
+    assert idx == 0 and count == 1
+
+
+def test_global_mesh_spans_devices():
+    mesh = distributed.global_mesh(model=2)
+    assert mesh.shape[DATA_AXIS] * mesh.shape["model"] == 8
+    assert mesh.shape["model"] == 2
+    mesh_all = distributed.global_mesh()
+    assert int(np.prod(list(mesh_all.shape.values()))) == 8
