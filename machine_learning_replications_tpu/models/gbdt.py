@@ -345,6 +345,120 @@ def _run_stumps(
     return jax.lax.fori_loop(start, stop, stage, carry)
 
 
+def fit_folds(
+    X: np.ndarray,
+    y: np.ndarray,
+    train_masks: np.ndarray,  # [k, n] 1.0 = row in that fold's fit
+    cfg: GBDTConfig = GBDTConfig(),
+    bins: binning.BinnedFeatures | None = None,
+) -> TreeEnsembleParams:
+    """All k masked fold fits as ONE vmapped XLA program — the stacking CV's
+    GBDT fan-out (SURVEY.md §3.2: sklearn refits the member per fold,
+    sequentially). Returns batched params with a leading fold axis on the
+    forest tensors and ``init_raw``.
+
+    Fold masking rides the shared grower: excluded rows park at node −1 and
+    carry zero gradient/hessian, so shapes are fold-independent. Candidate
+    thresholds come from the full matrix's bins (a superset of each fold's
+    value midpoints — partitions searchable by sklearn per fold remain
+    searchable here; only the real-valued threshold of a chosen split can
+    differ inside a gap, metric-level parity per SURVEY.md §7).
+    """
+    if bins is None:
+        bins = binning.bin_features(np.asarray(X), bin_budget_capped(cfg))
+    masks = jnp.asarray(np.asarray(train_masks))
+    feature, threshold, value, is_split, f0 = _run_binned_folds(
+        jnp.asarray(bins.binned),
+        jnp.asarray(bins.thresholds),
+        jnp.asarray(y),
+        masks,
+        n_stages=cfg.n_estimators,
+        depth=cfg.max_depth,
+        max_bins=bins.max_bins,
+        learning_rate=cfg.learning_rate,
+        min_samples_split=cfg.min_samples_split,
+        min_samples_leaf=cfg.min_samples_leaf,
+        backend="xla",  # segment_sum composes with vmap; the Pallas kernel
+                        # has no batching rule
+    )
+    M, NN = feature.shape[1], feature.shape[2]
+    idx = jnp.arange(NN, dtype=jnp.int32)[None, None, :]
+    left = jnp.where(is_split, 2 * idx + 1, idx).astype(jnp.int32)
+    right = jnp.where(is_split, 2 * idx + 2, idx).astype(jnp.int32)
+    return TreeEnsembleParams(
+        feature=feature, threshold=threshold, left=left, right=right,
+        value=value, init_raw=f0,
+        learning_rate=jnp.asarray(cfg.learning_rate),
+        max_depth=cfg.max_depth,
+    )
+
+
+def bin_budget_capped(cfg: GBDTConfig) -> int:
+    """``bin_budget`` but always bounded (the fold-vmapped path runs the
+    level-wise grower, whose allocation scales with the bin count)."""
+    b = bin_budget(cfg)
+    return cfg.n_bins if b is None else b
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_stages", "depth", "max_bins", "learning_rate",
+        "min_samples_split", "min_samples_leaf", "backend",
+    ),
+)
+def _run_binned_folds(
+    binned, thresholds, y, train_masks, *,
+    n_stages, depth, max_bins, learning_rate,
+    min_samples_split, min_samples_leaf, backend,
+):
+    dtype = thresholds.dtype
+    yf = y.astype(dtype)
+    n = yf.shape[0]
+    NN = 2 ** (depth + 1) - 1
+    hist_fn = resolve_hist_fn(backend)
+
+    def one_fold(w):
+        w = w.astype(dtype)
+        p1 = jnp.sum(yf * w) / jnp.sum(w)
+        f0 = jnp.log(p1 / (1.0 - p1))
+        grow_tree = make_tree_grower(
+            binned, thresholds,
+            depth=depth, max_bins=max_bins,
+            min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            hist_fn=hist_fn,
+            node_init=jnp.where(w > 0, 0, -1).astype(jnp.int32),
+        )
+
+        def stage(t, carry):
+            raw, feats, thrs, vals, splits = carry
+            p = jax.scipy.special.expit(raw)
+            g = (yf - p) * w
+            h = p * (1.0 - p) * w
+            feat_t, thr_t, val_t, split_t, node = grow_tree(g, h)
+            raw = raw + learning_rate * val_t[jnp.maximum(node, 0)] * w
+            return (
+                raw,
+                feats.at[t].set(feat_t),
+                thrs.at[t].set(thr_t),
+                vals.at[t].set(val_t),
+                splits.at[t].set(split_t),
+            )
+
+        init = (
+            jnp.full(n, f0, dtype),
+            jnp.zeros((n_stages, NN), jnp.int32),
+            jnp.full((n_stages, NN), jnp.inf, dtype),
+            jnp.zeros((n_stages, NN), dtype),
+            jnp.zeros((n_stages, NN), bool),
+        )
+        _, feats, thrs, vals, splits = jax.lax.fori_loop(0, n_stages, stage, init)
+        return feats, thrs, vals, splits, f0
+
+    return jax.vmap(one_fold)(train_masks)
+
+
 def _fit_binned(
     binned: jnp.ndarray,      # [n, F] int32
     thresholds: jnp.ndarray,  # [F, B-1]

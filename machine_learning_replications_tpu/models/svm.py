@@ -333,6 +333,72 @@ def svc_fit(
     )
 
 
+def svc_fit_masked(
+    Xt: jnp.ndarray,            # [n, F] scaler-transformed (fold scaler)
+    y: jnp.ndarray,             # [n]
+    train_mask: jnp.ndarray,    # [n] 1.0 = row in this fit
+    platt_test_masks: jnp.ndarray,  # [k, n] Platt-CV test masks ⊂ train_mask
+    C: float = 1.0,
+    gamma=None,
+    balanced: bool = True,
+    n_iter: int = 3000,
+) -> SVCParams:
+    """``svc_fit`` over a masked row subset with static shapes — the unit of
+    the stacking CV's vmapped fold fan-out (SURVEY.md §3.2: the reference
+    runs its 5 fold fits + nested Platt solves strictly sequentially).
+
+    Masking rides the dual formulation: a row with ``C_i = 0`` can never
+    receive dual weight, so ``Cvec · train_mask`` excludes it from the fit
+    while keeping every shape fold-independent. Excluded rows stay in the
+    support-vector array with zero coefficient (inert at predict time).
+    ``gamma=None`` reproduces sklearn's ``'scale'`` from the masked rows.
+    """
+    from machine_learning_replications_tpu.models.solvers import (
+        balanced_class_weights_masked,
+    )
+
+    Xt = jnp.asarray(Xt)
+    y = jnp.asarray(y)
+    dtype = Xt.dtype
+    n = Xt.shape[0]
+    m = train_mask.astype(dtype)
+    s = (2.0 * y - 1.0).astype(dtype)
+    if gamma is None:
+        # masked 'scale': 1 / (F · var(train rows, all entries))
+        n_eff = jnp.sum(m) * Xt.shape[1]
+        mu = jnp.sum(Xt * m[:, None]) / n_eff
+        var = jnp.sum(((Xt - mu) ** 2) * m[:, None]) / n_eff
+        gamma = 1.0 / (Xt.shape[1] * var)
+
+    K = rbf_kernel(Xt, Xt, gamma)
+    cw = (
+        balanced_class_weights_masked(y, m).astype(dtype)
+        if balanced
+        else jnp.ones(n, dtype)
+    )
+    Cvec = C * cw * m
+
+    alpha = solve_dual(K, s, Cvec, n_iter)
+    b = _intercept_from_alpha(K, s, Cvec, alpha)
+
+    def fold_dec(test_mask):
+        Cf = Cvec * (1.0 - test_mask)
+        af = solve_dual(K, s, Cf, n_iter)
+        bf = _intercept_from_alpha(K, s, Cf, af)
+        return (K @ (af * s) + bf) * test_mask
+
+    dec_cv = jnp.sum(jax.vmap(fold_dec)(platt_test_masks.astype(dtype)), axis=0)
+    A_fit, B_fit = platt_sigmoid_train(dec_cv, y.astype(dtype), sample_mask=m)
+    return SVCParams(
+        support_vectors=Xt,
+        dual_coef=alpha * s,
+        intercept=b,
+        gamma=jnp.asarray(gamma, dtype),
+        prob_a=-A_fit,
+        prob_b=B_fit,
+    )
+
+
 def trim_support(params: SVCParams, tol: float = 1e-10) -> SVCParams:
     """Drop zero-coefficient rows (host-side; dynamic shapes)."""
     keep = np.abs(np.asarray(params.dual_coef)) > tol

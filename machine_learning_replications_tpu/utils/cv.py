@@ -30,6 +30,21 @@ def kfold_test_masks(n: int, k: int) -> np.ndarray:
     return masks
 
 
+def stratified_kfold_test_masks_within(
+    y: np.ndarray, k: int, row_mask: np.ndarray
+) -> np.ndarray:
+    """Stratified k-fold test masks of the subset ``row_mask == 1``, expanded
+    back to full-length ``[k, n]`` masks (rows outside the subset are 0 in
+    every fold). Matches sklearn fitting ``StratifiedKFold(k)`` on the
+    subset — the nested Platt CV inside each stacking fold fit."""
+    y = np.asarray(y)
+    rows = np.where(np.asarray(row_mask) > 0.5)[0]
+    sub = stratified_kfold_test_masks(y[rows], k)  # [k, n_sub]
+    masks = np.zeros((k, y.shape[0]))
+    masks[:, rows] = sub
+    return masks
+
+
 def stratified_kfold_test_masks(y: np.ndarray, k: int) -> np.ndarray:
     """``StratifiedKFold(k, shuffle=False)`` exactly as sklearn assigns it:
     for each class, its occurrences (in row order) are dealt into folds in
