@@ -1,42 +1,311 @@
 #!/usr/bin/env python
-"""Driver benchmark harness — the five BASELINE.json configs as named entry
-points. Prints ONE JSON line:
+"""Driver benchmark harness — the five BASELINE.json configs, hardened.
+
+Prints exactly ONE JSON line on stdout on EVERY exit path:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Configs (``--config``, default 3 — the driver-recorded headline):
-  1  single-patient stacked inference, shipped-pickle weights
-     (``predict_hf.py`` flow; baseline = closed-form numpy on host CPU)
-  2  single decision tree on the HF cohort
-     (``GradientBoostingClassifier(n_estimators=1, max_depth=1)`` member)
-  3  full 100-stump GradientBoosting ensemble (``train_ensemble_public.py:45``)
-  4  5-fold CV sweep over the n_estimators × max_depth grid
-     (baseline = sklearn ``GridSearchCV``)
-  5  scaled synthetic cohort (default 10M rows), 256-bin hist splitter
-     (baseline = sklearn on a subsample, linearly extrapolated — an
-     *underestimate* of sklearn's true n·log n cost, so the reported
-     speedup is conservative)
+Round-1 failure modes this design answers (VERDICT.md "What's weak" #1):
+the 'axon' TPU plugin can hang *forever* at ``import jax`` / backend init,
+and the old harness ran minutes of sklearn baselines before first touching
+JAX, then died with no JSON at all. Therefore:
 
-The workload data is the Table-S1-matched synthetic cohort (the reference
-ships no data; SURVEY.md §6). Every training config checks AUC-ROC parity
-with sklearn within ±0.005 (BASELINE.json budget) and fails loudly if
-violated. Timing: one warmup (XLA compiles once), then the median of
-``--repeats`` end-to-end runs, each blocking on device completion.
+  * this orchestrator process NEVER imports jax (nor the package) — all
+    device and baseline work runs in subprocesses with hard timeouts;
+  * the TPU backend is probed first in short-timeout subprocesses (the hang
+    is intermittent — each retry is a fresh interpreter, a fresh chance);
+  * if the TPU never comes up, device legs fall back to a *clean* CPU
+    environment: the axon sitecustomize only registers its plugin when
+    ``PALLAS_AXON_POOL_IPS`` is set, so stripping that var yields an
+    interpreter that cannot hang (measured, honest, flagged "degraded");
+  * sklearn baseline legs always run in the clean environment — they can
+    never be taken down by the TPU tunnel;
+  * every exit path — success, parity violation, timeout, crash, budget
+    exhaustion — emits the JSON line; parity violations set
+    ``"parity_ok": false`` rather than dying silently.
+
+Configs (``--config``; default = all five, headline = config 3):
+  1  single-patient stacked inference from the shipped pickle's weights
+     (``predict_hf.py`` flow; baseline = same closed-form math in host numpy)
+  2  single decision stump on the HF cohort (``GBC(n_estimators=1)``)
+  3  full 100-stump GradientBoosting ensemble (``train_ensemble_public.py:45``)
+  4  5-fold CV sweep over the n_estimators × max_depth grid vs GridSearchCV
+  5  scaled synthetic cohort (default 10M rows) trained through the sharded
+     mesh path (``parallel.hist_trainer`` over ``make_mesh()`` — a 1-device
+     mesh is the same code path); baseline = sklearn on ``--baseline-rows``,
+     linearly extrapolated (an *underestimate* of sklearn's n·log n cost).
+     Both models are scored on the same held-out row slice, so the parity
+     check compares like for like (train sizes differ by design and are
+     recorded in the artifact).
+
+Workload data: the Table-S1-matched synthetic cohort (the reference ships
+none; SURVEY.md §6), regenerated deterministically inside each leg from the
+same seed. Every training config checks AUC-ROC parity within ±0.005
+(BASELINE.json budget). Timing: one warmup (XLA compiles once), then the
+median of ``--repeats`` runs, each blocking on device completion; per-phase
+wall-clock (``utils.trace.PhaseTimer``) and, for config 3 on TPU, a
+Perfetto trace under ``traces/`` plus an on-chip Pallas-vs-XLA histogram
+equality check (VERDICT.md next-round items 2 and 8).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import statistics
+import os
+import subprocess
 import sys
+import tempfile
 import time
-import warnings
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+PARITY_TOL = 0.005  # BASELINE.json AUC budget
+
+# Rows per config in full mode. Config 4's baseline is a 45-fit
+# GridSearchCV on one CPU core — it gets a smaller cohort by design.
+DEFAULT_ROWS = {1: 1, 2: 200_000, 3: 200_000, 4: 20_000, 5: 10_000_000}
+# Shrunken rows when the TPU is unreachable and legs run on 1-core CPU JAX:
+# still an honest differential measurement, just sized to finish.
+DEGRADED_ROWS = {1: 1, 2: 50_000, 3: 50_000, 4: 5_000, 5: 500_000}
+DEVICE_TIMEOUT = {1: 420, 2: 600, 3: 780, 4: 900, 5: 1500}
+BASELINE_TIMEOUT = {1: 0, 2: 420, 3: 700, 4: 900, 5: 900}
+
+
+def log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator: environments, probes, subprocess legs
+# ---------------------------------------------------------------------------
+
+
+def clean_env() -> dict:
+    """Interpreter env that cannot touch the TPU tunnel (shared recipe:
+    ``machine_learning_replications_tpu.envsafe`` — importable here because
+    the package root only pulls in the pure-python config layer)."""
+    sys.path.insert(0, REPO)
+    from machine_learning_replications_tpu.envsafe import clean_cpu_env
+
+    return clean_cpu_env()
+
+
+def probe_tpu(attempts: int = 3, timeout: int = 150) -> str | None:
+    """Try to initialize the ambient (TPU) backend in fresh subprocesses.
+
+    Returns the device kind string, or None if every attempt hung/failed.
+    Each attempt is a new interpreter — the round-1 hang was intermittent
+    (1-in-5 success per VERDICT.md), so retries are the defense.
+    """
+    code = "import jax; d = jax.devices()[0]; print('PROBE_OK', d.platform, '|', d.device_kind, flush=True)"
+    for i in range(attempts):
+        log(f"TPU probe attempt {i + 1}/{attempts} (timeout {timeout}s)")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                timeout=timeout, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            log("probe timed out (backend hang)")
+            continue
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("PROBE_OK"):
+                kind = line.split("PROBE_OK", 1)[1].strip()
+                log(f"TPU backend up: {kind}")
+                return kind
+        tail = (r.stdout or "").strip().splitlines()[-3:]
+        log(f"probe rc={r.returncode}: {' / '.join(tail)}")
+    return None
+
+
+def run_leg(
+    leg: str, config: int, env: dict, timeout: int, extra: list[str],
+    attempts: int = 2, deadline: float | None = None,
+) -> dict:
+    """Run one measurement leg in a subprocess; parse its JSON result file.
+
+    The leg's stdout/stderr stream to our stderr (the driver's tail stays
+    diagnosable); results travel via a temp file so a crashed leg can never
+    corrupt the stdout JSON contract. Returns {"error": ...} on failure.
+    Every attempt's timeout is clamped to the orchestrator ``deadline`` so
+    retries can never push the whole run past --budget (the no-JSON
+    rc=124 failure mode this harness exists to prevent).
+    """
+    last_err = "unknown"
+    for i in range(attempts):
+        if deadline is not None:
+            remaining = int(deadline - time.perf_counter())
+            if remaining < 30:
+                return {"error": f"{last_err}; no budget left for attempt {i + 1}"
+                        if last_err != "unknown" else "no budget left"}
+            timeout = min(timeout, remaining)
+        fd, out_path = tempfile.mkstemp(suffix=".json", prefix=f"bench_{leg}{config}_")
+        os.close(fd)
+        cmd = [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--leg", leg, "--config", str(config), "--json-out", out_path,
+        ] + extra
+        log(f"{leg} leg c{config} attempt {i + 1}/{attempts} (timeout {timeout}s)")
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                cmd, cwd=REPO, env=env, stdout=sys.stderr, stderr=sys.stderr,
+                timeout=timeout,
+            )
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            last_err = f"leg timed out after {timeout}s"
+            log(last_err)
+            os.unlink(out_path)
+            continue
+        dt = time.perf_counter() - t0
+        try:
+            with open(out_path) as f:
+                payload = json.load(f)
+            os.unlink(out_path)
+        except (OSError, json.JSONDecodeError):
+            payload = None
+            os.unlink(out_path)
+        if payload is not None and "error" not in payload:
+            log(f"{leg} leg c{config} done in {dt:.1f}s")
+            return payload
+        last_err = (payload or {}).get("error", f"leg rc={rc}, no JSON written")
+        log(f"{leg} leg c{config} failed: {last_err}")
+    return {"error": last_err}
+
+
+def orchestrate(args) -> int:
+    t_start = time.perf_counter()
+    deadline = t_start + args.budget
+    configs = [args.config] if args.config else [3, 1, 2, 5, 4]
+
+    # --- phase 1: bring up the device backend --------------------------
+    kind = None if args.force_cpu else probe_tpu()
+    degraded = kind is None
+    if degraded:
+        log("TPU unreachable after retries — device legs fall back to clean-env CPU")
+        device_env = clean_env()
+    else:
+        device_env = dict(os.environ)
+
+    results: dict[str, dict] = {}
+    for c in configs:
+        remaining = deadline - time.perf_counter()
+        if remaining < 60:
+            results[str(c)] = {"error": f"skipped: budget exhausted ({args.budget}s)"}
+            log(f"config {c} skipped — budget exhausted")
+            continue
+
+        rows = args.rows or (DEGRADED_ROWS if degraded else DEFAULT_ROWS)[c]
+        # Trace gating lives HERE: the worker's own --trace default is '',
+        # so an omitted flag means no tracing in the leg.
+        trace = (args.trace or "traces/bench_c3") if (c == 3 and not degraded) else ""
+
+        def leg_args(leg_rows: int, leg_trace: str) -> list[str]:
+            return ["--rows", str(leg_rows), "--repeats", str(args.repeats),
+                    "--cpu-repeats", str(args.cpu_repeats),
+                    "--splitter", args.splitter,
+                    "--baseline-rows", str(args.baseline_rows),
+                    "--trace", leg_trace]
+
+        dev = run_leg("device", c, device_env, DEVICE_TIMEOUT[c],
+                      leg_args(rows, trace), deadline=deadline)
+        if "error" in dev and not degraded:
+            # TPU leg failed twice — one clean-env CPU try so the artifact
+            # still carries a measured number (flagged below).
+            log(f"config {c}: TPU leg failed, retrying on clean-env CPU")
+            cpu_rows = args.rows or DEGRADED_ROWS[c]
+            extra_cpu = leg_args(cpu_rows, "")
+            tpu_err = dev["error"]
+            dev = run_leg("device", c, clean_env(), DEVICE_TIMEOUT[c],
+                          extra_cpu, attempts=1, deadline=deadline)
+            dev["tpu_error"] = tpu_err
+            rows = cpu_rows
+
+        if c != 1 and "error" not in dev:
+            base = run_leg(
+                "baseline", c, clean_env(), BASELINE_TIMEOUT[c],
+                ["--rows", str(rows), "--cpu-repeats", str(args.cpu_repeats),
+                 "--baseline-rows", str(args.baseline_rows)],
+                deadline=deadline,
+            )
+        elif c == 1:
+            base = {}  # config 1's numpy baseline is measured inside the leg
+        else:
+            base = {"error": "skipped: device leg failed"}
+
+        results[str(c)] = combine(c, rows, dev, base)
+        log(f"config {c} result: {json.dumps(results[str(c)])[:400]}")
+
+    # --- emit the single JSON line -------------------------------------
+    headline_cfg = str(args.config or 3)
+    head = results.get(headline_cfg, {"error": "headline config never ran"})
+    # parity_ok distinguishes checked-and-passed from never-checked: it is
+    # true only when ≥1 config ran its AUC parity check and none failed it;
+    # parity_checked counts the configs that actually verified.
+    checked = [r for r in results.values() if "parity_ok" in r]
+    payload = {
+        "metric": head.get("metric", f"config{headline_cfg}_failed"),
+        "value": head.get("value", 0.0),
+        "unit": head.get("unit", "s"),
+        "vs_baseline": head.get("vs_baseline", 0.0),
+        "device": head.get("device", "unreachable"),
+        "parity_ok": bool(checked) and all(r["parity_ok"] for r in checked),
+        "parity_checked": len(checked),
+        "degraded_cpu_fallback": degraded,
+        "wall_s_total": round(time.perf_counter() - t_start, 1),
+    }
+    if len(results) > 1 or str(args.config or "") not in results:
+        payload["configs"] = results
+    else:
+        payload.update({k: v for k, v in head.items() if k not in payload})
+    errors = {c: r["error"] for c, r in results.items() if "error" in r}
+    if errors:
+        payload["errors"] = errors
+    print(json.dumps(payload), flush=True)
+    ok = "error" not in head and payload["parity_ok"]
+    return 0 if ok else 1
+
+
+def combine(c: int, rows: int, dev: dict, base: dict) -> dict:
+    """Merge a config's device + baseline legs into one result record."""
+    if "error" in dev:
+        rec = {"error": f"device leg: {dev['error']}"}
+        if "tpu_error" in dev:  # keep the original TPU failure diagnosable
+            rec["tpu_error"] = dev["tpu_error"]
+        return rec
+    rec = dict(dev)
+    rec.setdefault("unit", "s")
+    if c == 1:
+        return rec  # leg already carries vs_baseline (host numpy)
+    if "error" in base:
+        rec["baseline_error"] = base["error"]
+        rec.setdefault("vs_baseline", 0.0)
+        return rec
+    cpu_s = base["cpu_s"]
+    rec["vs_baseline"] = round(cpu_s / rec["value"], 3)
+    rec["baseline_wall_s"] = round(cpu_s, 4)
+    for k in ("baseline_measured_rows", "baseline_measured_s"):
+        if k in base:
+            rec[k] = base[k]
+    if "auc" in rec and "auc" in base:
+        delta = abs(rec["auc"] - base["auc"])
+        rec["auc_delta_vs_sklearn"] = round(delta, 8)
+        rec["parity_ok"] = bool(delta <= PARITY_TOL)
+        if not rec["parity_ok"]:
+            log(f"PARITY VIOLATION config {c}: ours={rec['auc']:.6f} "
+                f"sklearn={base['auc']:.6f}")
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Legs (run in subprocesses; these DO import jax / sklearn)
+# ---------------------------------------------------------------------------
 
 
 def _median_time(fn, repeats: int, *, warmup: bool = True) -> float:
-    """Median wall-clock of ``repeats`` calls. ``warmup`` runs one untimed
-    call first (XLA compile); CPU sklearn baselines pass ``warmup=False`` —
-    there is nothing to warm and the fits dominate the harness runtime."""
+    import statistics
+
     if warmup:
         fn()
     times = []
@@ -45,10 +314,6 @@ def _median_time(fn, repeats: int, *, warmup: bool = True) -> float:
         fn()
         times.append(time.perf_counter() - t0)
     return statistics.median(times)
-
-
-def _emit(payload: dict) -> None:
-    print(json.dumps(payload))
 
 
 def _cohort(rows: int, seed: int = 2020):
@@ -62,9 +327,39 @@ def _cohort(rows: int, seed: int = 2020):
     return X17, np.asarray(y), np.asarray(y, dtype=np.float32)
 
 
-def bench_inference(args) -> None:
-    """Config 1: the predict_hf.py flow — stacked predict_proba from the
-    shipped pickle's decoded weights, one patient + a batch."""
+def _device_kind() -> str:
+    import jax
+
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.device_kind}"
+
+
+def _is_tpu() -> bool:
+    import jax
+
+    d = jax.devices()[0]
+    return d.platform in ("tpu", "axon") or "tpu" in d.device_kind.lower()
+
+
+def device_leg(args) -> dict:
+    log(f"device leg c{args.config} starting (rows={args.rows})")
+    import jax
+
+    log(f"jax backend up: {_device_kind()}")
+    if args.config == 1:
+        return device_leg_inference(args)
+    if args.config in (2, 3):
+        return device_leg_gbdt(args, 1 if args.config == 2 else 100)
+    if args.config == 4:
+        return device_leg_sweep(args)
+    return device_leg_scaled(args)
+
+
+def device_leg_inference(args) -> dict:
+    """Config 1: predict_hf.py flow — stacked predict_proba from the shipped
+    pickle's decoded weights; baseline = the same closed-form math (SURVEY.md
+    §3.4) in host numpy (the modern stand-in for the reference's sklearn-0.23
+    predict path, which current sklearn cannot execute from the pickle)."""
     import jax
     import numpy as np
 
@@ -78,34 +373,22 @@ def bench_inference(args) -> None:
 
     params = import_stacking(decode_pickle(REFERENCE_PKL_PATH))
     x1 = patient_row().reshape(1, -1)
-
     predict = jax.jit(stacking.predict_proba1)
-
-    def device_once():
-        jax.block_until_ready(predict(params, x1))
-
-    tpu_s = _median_time(device_once, args.repeats * 10)
-
-    # Baseline: the same closed-form math (SURVEY.md §3.4) in numpy on host —
-    # the modern stand-in for the reference's sklearn-0.23 predict path,
-    # which current sklearn cannot execute from the shipped pickle.
+    dev_s = _median_time(
+        lambda: jax.block_until_ready(predict(params, x1)), args.repeats * 10
+    )
     np_params = jax.tree.map(np.asarray, params)
-
-    def host_once():
-        _numpy_stacked_predict(np_params, x1)
-
-    cpu_s = _median_time(host_once, args.repeats * 10)
-
+    cpu_s = _median_time(lambda: _numpy_stacked_predict(np_params, x1), args.repeats * 10)
     prob = float(predict(params, x1)[0])
-    _emit({
+    return {
         "metric": "stacked_inference_latency_1patient",
-        "value": round(tpu_s * 1e3, 4),
+        "value": round(dev_s * 1e3, 4),
         "unit": "ms",
-        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "vs_baseline": round(cpu_s / dev_s, 3),
         "baseline_ms": round(cpu_s * 1e3, 4),
         "probability_pct": round(100 * prob, 2),
         "device": _device_kind(),
-    })
+    }
 
 
 def _numpy_stacked_predict(p, X):
@@ -137,202 +420,334 @@ def _numpy_stacked_predict(p, X):
     return 1.0 / (1.0 + np.exp(-zm))
 
 
-def bench_gbdt(args, n_estimators: int, metric: str) -> None:
-    """Configs 2 & 3: the reference's exact GBDT estimator vs sklearn."""
+def device_leg_gbdt(args, n_estimators: int) -> dict:
+    """Configs 2 & 3: the reference's exact GBDT estimator on device, with
+    per-phase wall-clock; config 3 on TPU additionally captures a Perfetto
+    trace and runs the on-chip Pallas-vs-XLA histogram equality check."""
     import jax
 
     from machine_learning_replications_tpu.config import GBDTConfig
     from machine_learning_replications_tpu.models import gbdt, tree
+    from machine_learning_replications_tpu.ops import binning
     from machine_learning_replications_tpu.utils import metrics
+    from machine_learning_replications_tpu.utils.trace import PhaseTimer, device_trace
 
-    X17, y, yf = _cohort(args.rows)
-
-    from sklearn.ensemble import GradientBoostingClassifier
-
-    sk_holder = {}
-
-    def cpu_fit():
-        sk_holder["m"] = GradientBoostingClassifier(
-            n_estimators=n_estimators, max_depth=1, random_state=2020
-        ).fit(X17, y)
-
-    cpu_s = _median_time(cpu_fit, args.cpu_repeats, warmup=False)
-    auc_sk = float(metrics.roc_auc(y, sk_holder["m"].predict_proba(X17)[:, 1]))
-
+    timer = PhaseTimer()
+    with timer.phase("make_cohort"):
+        X17, y, yf = _cohort(args.rows)
     cfg = GBDTConfig(splitter=args.splitter, n_estimators=n_estimators)
+    # Recorded for the phase breakdown only — the timed fit below re-bins
+    # from scratch so the measurement covers the same end-to-end work as
+    # the sklearn baseline's fit() (which includes its presort).
+    with timer.phase("binning"):
+        binning.bin_features(X17, gbdt.bin_budget(cfg))
+
     holder = {}
 
-    def tpu_fit():
+    def fit_once():
         params, _ = gbdt.fit(X17, yf, cfg)
         jax.block_until_ready(params.value)
         holder["params"] = params
 
-    tpu_s = _median_time(tpu_fit, args.repeats)
-    auc_tpu = float(metrics.roc_auc(y, tree.predict_proba1(holder["params"], X17)))
-    _check_parity(auc_tpu, auc_sk)
+    with timer.phase("fit_warmup_compile"):
+        fit_once()
+    with timer.phase("fit_timed"):
+        dev_s = _median_time(fit_once, args.repeats, warmup=False)
+    with timer.phase("predict_auc") as ph:
+        auc = float(metrics.roc_auc(y, ph.block(tree.predict_proba1(holder["params"], X17))))
 
-    print(
-        f"rows={args.rows} device={_device_kind()} "
-        f"sklearn_cpu={cpu_s:.3f}s tpu={tpu_s:.3f}s "
-        f"auc sklearn={auc_sk:.6f} tpu={auc_tpu:.6f}",
-        file=sys.stderr,
-    )
-    _emit({
-        "metric": metric,
-        "value": round(tpu_s, 4),
+    rec = {
+        "metric": (
+            f"single_stump_train_{args.rows}rows" if n_estimators == 1
+            else f"gbdt100_train_wall_clock_{args.rows}rows"
+        ),
+        "value": round(dev_s, 4),
         "unit": "s",
-        "vs_baseline": round(cpu_s / tpu_s, 3),
-        "baseline_wall_s": round(cpu_s, 4),
-        "auc_delta_vs_sklearn": round(abs(auc_tpu - auc_sk), 8),
+        "auc": auc,
         "device": _device_kind(),
-    })
+        "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
+    }
+
+    if args.trace and n_estimators > 1:
+        trace_dir = os.path.join(REPO, args.trace)
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            with device_trace(trace_dir):
+                fit_once()
+            rec["trace_dir"] = args.trace
+            log(f"profiler trace written to {trace_dir}")
+        except Exception as e:  # profiling is best-effort on the plugin backend
+            rec["trace_error"] = f"{type(e).__name__}: {e}"
+
+    if _is_tpu() and n_estimators > 1:
+        try:
+            rec["pallas_onchip"] = pallas_onchip_check(X17, yf)
+        except Exception as e:
+            rec["pallas_onchip"] = {"error": f"{type(e).__name__}: {e}"}
+    return rec
 
 
-def bench_sweep(args) -> None:
-    """Config 4: the CV grid sweep vs sklearn GridSearchCV."""
+def pallas_onchip_check(X17, yf) -> dict:
+    """On-TPU correctness + timing of the Pallas histogram kernel against the
+    XLA segment_sum path at real sizes (VERDICT.md item 8: the kernel had
+    only ever run in interpret mode on CPU; the Mosaic lowering and VMEM
+    accumulation pattern are exactly what this validates)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.ops import binning, histogram
+    from machine_learning_replications_tpu.ops.pallas_histogram import (
+        node_histograms_pallas,
+    )
+
+    bins = binning.bin_features(X17, 256)
+    n = X17.shape[0]
+    K = 8  # a depth-3 level
+    rng = np.random.default_rng(0)
+    node = jnp.asarray(rng.integers(0, K, n, dtype=np.int32))
+    g = jnp.asarray(yf - 0.5)
+    h = jnp.asarray(0.25 * np.ones(n, np.float32))
+    binned = jnp.asarray(bins.binned)
+
+    # Arrays passed as jit ARGUMENTS (not closed-over constants) so XLA
+    # cannot constant-fold the measured computation away.
+    run_p = jax.jit(node_histograms_pallas, static_argnums=(4, 5))
+    run_x = jax.jit(histogram.node_histograms, static_argnums=(4, 5))
+    hp = jax.block_until_ready(run_p(binned, node, g, h, K, bins.max_bins))
+    hx = jax.block_until_ready(run_x(binned, node, g, h, K, bins.max_bins))
+    for a, b, name in zip(hp, hx, ("grad", "hess", "count")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-2,
+            err_msg=f"pallas vs xla histogram mismatch: {name}",
+        )
+    t_p = _median_time(
+        lambda: jax.block_until_ready(run_p(binned, node, g, h, K, bins.max_bins)), 5
+    )
+    t_x = _median_time(
+        lambda: jax.block_until_ready(run_x(binned, node, g, h, K, bins.max_bins)), 5
+    )
+    return {
+        "equal": True,
+        "rows": n,
+        "nodes": K,
+        "pallas_ms": round(t_p * 1e3, 3),
+        "xla_ms": round(t_x * 1e3, 3),
+        "kernel_speedup_vs_xla": round(t_x / t_p, 2),
+    }
+
+
+def device_leg_sweep(args) -> dict:
+    """Config 4: the staged-prediction CV grid sweep on device."""
     from machine_learning_replications_tpu.config import SweepConfig
     from machine_learning_replications_tpu.models import sweep as sweep_mod
 
     X17, y, yf = _cohort(args.rows)
-    grid_est = (25, 50, 100)
-    grid_depth = (1, 2, 3)
     cfg = SweepConfig(
-        n_estimators_grid=grid_est, max_depth_grid=grid_depth, cv_folds=5
+        n_estimators_grid=(25, 50, 100), max_depth_grid=(1, 2, 3), cv_folds=5
     )
-
     holder = {}
 
     def ours():
         holder["res"] = sweep_mod.cv_sweep(X17, yf, cfg)
 
-    tpu_s = _median_time(ours, args.repeats)
+    dev_s = _median_time(ours, args.repeats)
     res = holder["res"]
-
-    from sklearn.ensemble import GradientBoostingClassifier
-    from sklearn.model_selection import GridSearchCV
-
-    sk_holder = {}
-
-    def sk_fit():
-        sk_holder["gs"] = GridSearchCV(
-            GradientBoostingClassifier(random_state=2020),
-            {"n_estimators": list(grid_est), "max_depth": list(grid_depth)},
-            scoring="roc_auc",
-            cv=5,
-        ).fit(X17, y)
-
-    cpu_s = _median_time(sk_fit, args.cpu_repeats, warmup=False)
-    gs = sk_holder["gs"]
-    _check_parity(res.best_mean_auc, float(gs.best_score_))
-
-    _emit({
-        "metric": f"cv_sweep_{len(grid_est)}x{len(grid_depth)}_grid_{args.rows}rows",
-        "value": round(tpu_s, 4),
+    return {
+        "metric": f"cv_sweep_3x3_grid_{args.rows}rows",
+        "value": round(dev_s, 4),
         "unit": "s",
-        "vs_baseline": round(cpu_s / tpu_s, 3),
-        "baseline_wall_s": round(cpu_s, 4),
-        "best_auc_delta": round(abs(res.best_mean_auc - float(gs.best_score_)), 8),
+        "auc": float(res.best_mean_auc),
+        "best_cell": [res.best_max_depth, res.best_n_estimators],
         "device": _device_kind(),
-    })
+    }
 
 
-def bench_scaled(args) -> None:
-    """Config 5: scaled cohort, hist splitter. Baseline extrapolated from a
-    sklearn fit on ``--baseline-rows`` (linear in n — conservative for the
-    baseline's true n·log n growth)."""
+def device_leg_scaled(args) -> dict:
+    """Config 5: scaled cohort through the real sharded path — mesh over all
+    available devices, rows sharded on the 'data' axis, level-wise histogram
+    trainer with psum'd partials (VERDICT.md item 4: a 1-device mesh is the
+    same code path; an honest artifact either way)."""
     import jax
 
     from machine_learning_replications_tpu.config import GBDTConfig
-    from machine_learning_replications_tpu.models import gbdt, tree
+    from machine_learning_replications_tpu.models import tree
+    from machine_learning_replications_tpu.parallel import hist_trainer, make_mesh
     from machine_learning_replications_tpu.utils import metrics
+    from machine_learning_replications_tpu.utils.trace import PhaseTimer
 
-    rows = args.rows if args.rows is not None else 10_000_000
-    X17, y, yf = _cohort(rows)
+    timer = PhaseTimer()
+    rows = args.rows
+    holdout = min(100_000, rows // 10)
+    with timer.phase("make_cohort"):
+        X17, y, yf = _cohort(rows)
+    Xtr, ytr = X17[: rows - holdout], yf[: rows - holdout]
+    Xte, yte = X17[rows - holdout:], y[rows - holdout:]
 
+    mesh = make_mesh()
     cfg = GBDTConfig(splitter="hist", n_bins=256)
     holder = {}
 
-    def tpu_fit():
-        params, _ = gbdt.fit(X17, yf, cfg)
+    def fit_once():
+        params, _ = hist_trainer.fit(mesh, Xtr, ytr, cfg)
         jax.block_until_ready(params.value)
         holder["params"] = params
 
-    tpu_s = _median_time(tpu_fit, args.repeats)
-    auc_tpu = float(metrics.roc_auc(y, tree.predict_proba1(holder["params"], X17)))
+    with timer.phase("fit_warmup_compile"):
+        fit_once()
+    with timer.phase("fit_timed"):
+        dev_s = _median_time(fit_once, args.repeats, warmup=False)
+    with timer.phase("predict_auc") as ph:
+        auc = float(metrics.roc_auc(yte, ph.block(tree.predict_proba1(holder["params"], Xte))))
+    return {
+        "metric": f"gbdt100_hist_train_{rows}rows_sharded",
+        "value": round(dev_s, 4),
+        "unit": "s",
+        "auc": auc,
+        "train_rows": rows - holdout,
+        "holdout_rows": holdout,
+        "mesh": {k: int(v) for k, v in zip(mesh.axis_names, mesh.devices.shape)},
+        "throughput_rows_per_s": round((rows - holdout) / dev_s, 1),
+        "device": _device_kind(),
+        "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
+    }
 
+
+def baseline_leg(args) -> dict:
+    """sklearn CPU baselines — always in the clean env, never on the TPU."""
+    log(f"baseline leg c{args.config} starting (rows={args.rows})")
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    if args.config in (2, 3):
+        return baseline_leg_gbdt(args, 1 if args.config == 2 else 100)
+    if args.config == 4:
+        return baseline_leg_sweep(args)
+    if args.config == 5:
+        return baseline_leg_scaled(args)
+    raise ValueError(f"no baseline leg for config {args.config}")
+
+
+def baseline_leg_gbdt(args, n_estimators: int) -> dict:
     from sklearn.ensemble import GradientBoostingClassifier
 
-    nb = min(args.baseline_rows, rows)
+    from machine_learning_replications_tpu.utils import metrics
+
+    X17, y, _ = _cohort(args.rows)
+    holder = {}
+
+    def fit():
+        holder["m"] = GradientBoostingClassifier(
+            n_estimators=n_estimators, max_depth=1, random_state=2020
+        ).fit(X17, y)
+
+    cpu_s = _median_time(fit, args.cpu_repeats, warmup=False)
+    auc = float(metrics.roc_auc(y, holder["m"].predict_proba(X17)[:, 1]))
+    return {"cpu_s": cpu_s, "auc": auc}
+
+
+def baseline_leg_sweep(args) -> dict:
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.model_selection import GridSearchCV
+
+    X17, y, _ = _cohort(args.rows)
+    holder = {}
+
+    def fit():
+        holder["gs"] = GridSearchCV(
+            GradientBoostingClassifier(random_state=2020),
+            {"n_estimators": [25, 50, 100], "max_depth": [1, 2, 3]},
+            scoring="roc_auc", cv=5,
+        ).fit(X17, y)
+
+    cpu_s = _median_time(fit, args.cpu_repeats, warmup=False)
+    return {"cpu_s": cpu_s, "auc": float(holder["gs"].best_score_)}
+
+
+def baseline_leg_scaled(args) -> dict:
+    """sklearn on a subsample of the same train slice, linearly extrapolated
+    (conservative: sklearn's presort is n·log n); scored on the same held-out
+    slice the device leg uses."""
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from machine_learning_replications_tpu.utils import metrics
+
+    rows = args.rows
+    holdout = min(100_000, rows // 10)
+    X17, y, _ = _cohort(rows)
+    train_rows = rows - holdout
+    nb = min(args.baseline_rows, train_rows)
     t0 = time.perf_counter()
-    sk = GradientBoostingClassifier(
+    m = GradientBoostingClassifier(
         n_estimators=100, max_depth=1, random_state=2020
     ).fit(X17[:nb], y[:nb])
-    cpu_sub_s = time.perf_counter() - t0
-    cpu_s = cpu_sub_s * (rows / nb)
-    auc_sk = float(metrics.roc_auc(y, sk.predict_proba(X17)[:, 1]))
-    _check_parity(auc_tpu, auc_sk)
-
-    _emit({
-        "metric": f"gbdt100_hist_train_{rows}rows",
-        "value": round(tpu_s, 4),
-        "unit": "s",
-        "vs_baseline": round(cpu_s / tpu_s, 3),
-        "baseline_wall_s_extrapolated": round(cpu_s, 2),
+    measured = time.perf_counter() - t0
+    auc = float(metrics.roc_auc(y[train_rows:], m.predict_proba(X17[train_rows:])[:, 1]))
+    return {
+        "cpu_s": measured * (train_rows / nb),
+        "auc": auc,
         "baseline_measured_rows": nb,
-        "throughput_rows_per_s": round(rows / tpu_s, 1),
-        "auc_delta_vs_sklearn": round(abs(auc_tpu - auc_sk), 8),
-        "device": _device_kind(),
-    })
+        "baseline_measured_s": round(measured, 4),
+    }
 
 
-def _check_parity(auc_ours: float, auc_sk: float) -> None:
-    if abs(auc_ours - auc_sk) > 0.005:
-        print(
-            f"FAIL: AUC parity violated: ours={auc_ours:.6f} sklearn={auc_sk:.6f}",
-            file=sys.stderr,
-        )
-        sys.exit(1)
+# ---------------------------------------------------------------------------
+# Entry
+# ---------------------------------------------------------------------------
 
 
-def _device_kind() -> str:
-    import jax
-
-    return str(jax.devices()[0].device_kind)
-
-
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--config", type=int, choices=(1, 2, 3, 4, 5), default=3)
-    ap.add_argument(
-        "--rows", type=int, default=None,
-        help="cohort rows (default: 200k for configs 1-4, 10M for config 5)",
-    )
+def main() -> int:
+    ap = argparse.ArgumentParser(description="hardened five-config bench harness")
+    ap.add_argument("--config", type=int, choices=(1, 2, 3, 4, 5), default=None,
+                    help="run one config (default: all five, headline config 3)")
+    ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--cpu-repeats", type=int, default=1)
     ap.add_argument("--baseline-rows", type=int, default=200_000,
                     help="config 5: sklearn baseline subsample size")
-    ap.add_argument(
-        "--splitter", choices=("exact", "hist"), default="exact",
-        help="split search for configs 2-3: 'exact' enumerates every "
-        "unique-value midpoint (sklearn BestSplitter semantics); 'hist' "
-        "caps candidates at 256 quantile bins",
-    )
+    ap.add_argument("--splitter", choices=("exact", "hist"), default="exact")
+    ap.add_argument("--budget", type=int, default=1800,
+                    help="orchestrator wall-clock budget (s)")
+    ap.add_argument("--trace", default="",
+                    help="profiler trace dir for config 3 on TPU; the "
+                    "orchestrator default is traces/bench_c3 ('' disables)")
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="skip the TPU probe; run device legs on clean-env CPU")
+    ap.add_argument("--leg", choices=("device", "baseline"), default=None,
+                    help=argparse.SUPPRESS)  # internal: subprocess worker mode
+    ap.add_argument("--json-out", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
-    warnings.filterwarnings("ignore")
-    if args.rows is None and args.config != 5:
-        args.rows = 200_000
 
-    if args.config == 1:
-        bench_inference(args)
-    elif args.config == 2:
-        bench_gbdt(args, 1, f"single_stump_train_{args.rows}rows")
-    elif args.config == 3:
-        bench_gbdt(args, 100, f"gbdt100_train_wall_clock_{args.rows}rows")
-    elif args.config == 4:
-        bench_sweep(args)
-    else:
-        bench_scaled(args)
+    if args.leg:
+        # Worker mode: write a result file no matter what happens.
+        if args.rows is None:
+            args.rows = DEFAULT_ROWS[args.config or 3]
+        try:
+            rec = device_leg(args) if args.leg == "device" else baseline_leg(args)
+        except BaseException as e:  # noqa: BLE001 — the file IS the error channel
+            rec = {"error": f"{type(e).__name__}: {e}"}
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+        with open(args.json_out, "w") as f:
+            json.dump(rec, f)
+        return 0 if "error" not in rec else 1
+
+    try:
+        return orchestrate(args)
+    except BaseException as e:  # noqa: BLE001 — stdout JSON on every exit path
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench_orchestrator_failed",
+            "value": 0.0,
+            "unit": "s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+        return 1
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

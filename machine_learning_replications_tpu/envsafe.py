@@ -1,0 +1,47 @@
+"""Environment hygiene for the flaky ambient TPU plugin — shared, jax-free.
+
+The driver machine's sitecustomize registers an 'axon' TPU backend in every
+interpreter whose env carries ``PALLAS_AXON_POOL_IPS``, and that plugin can
+hang forever at ``import jax`` / backend init (VERDICT.md round 1). This
+module is the single copy of the two defenses every entry point needs, and
+deliberately imports nothing heavy so the orchestrating processes
+(``bench.py``, ``__graft_entry__``) can use it without touching jax:
+
+  * ``clean_cpu_env`` — a child env the sitecustomize cannot wedge;
+  * ``force_host_device_flag`` — XLA_FLAGS surgery for an N-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+
+# The sitecustomize's guard variable (its first line checks this) and the
+# PYTHONPATH entry that makes Python find it.
+_PLUGIN_GUARD_VAR = "PALLAS_AXON_POOL_IPS"
+
+
+def force_host_device_flag(flags: str, n_devices: int) -> str:
+    """Return ``flags`` with exactly one
+    ``--xla_force_host_platform_device_count=n_devices`` (read by jax's CPU
+    backend at init time, so setting it pre-init is sufficient even when jax
+    is already imported)."""
+    parts = [
+        p for p in flags.split()
+        if "xla_force_host_platform_device_count" not in p
+    ]
+    parts.append(f"--xla_force_host_platform_device_count={n_devices}")
+    return " ".join(parts)
+
+
+def clean_cpu_env(n_devices: int | None = None) -> dict:
+    """A child-process env in which ``import jax`` cannot hang: the plugin
+    guard variable is stripped (sitecustomize no-ops), the sitecustomize's
+    PYTHONPATH entry is dropped, and JAX_PLATFORMS pins the CPU backend.
+    With ``n_devices``, also forces an N-device virtual CPU mesh."""
+    env = dict(os.environ)
+    env.pop(_PLUGIN_GUARD_VAR, None)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        env["XLA_FLAGS"] = force_host_device_flag(env.get("XLA_FLAGS", ""), n_devices)
+    return env
