@@ -32,7 +32,7 @@ def fit_select(
         cv_folds=cfg.cv_folds,
         n_alphas=cfg.n_alphas,
         eps=cfg.eps,
-        n_iter=cfg.max_iter,
+        tol=cfg.tol, max_iter=cfg.max_iter,
     )
     mask = select_top_k(np.asarray(coef), cfg.max_features)
     info = {

@@ -367,6 +367,7 @@ def fit_folds(
     if bins is None:
         bins = binning.bin_features(np.asarray(X), bin_budget_capped(cfg))
     masks = jnp.asarray(np.asarray(train_masks))
+    k = masks.shape[0]
     feature, threshold, value, is_split, f0 = _run_binned_folds(
         jnp.asarray(bins.binned),
         jnp.asarray(bins.thresholds),
@@ -385,10 +386,13 @@ def fit_folds(
     idx = jnp.arange(NN, dtype=jnp.int32)[None, None, :]
     left = jnp.where(is_split, 2 * idx + 1, idx).astype(jnp.int32)
     right = jnp.where(is_split, 2 * idx + 2, idx).astype(jnp.int32)
+    # Every array leaf carries the leading fold axis (learning_rate included)
+    # so the result vmaps directly, e.g. ``jax.vmap(lambda p:
+    # tree.predict_proba1(p, X))(params)``.
     return TreeEnsembleParams(
         feature=feature, threshold=threshold, left=left, right=right,
         value=value, init_raw=f0,
-        learning_rate=jnp.asarray(cfg.learning_rate),
+        learning_rate=jnp.full((k,), cfg.learning_rate, threshold.dtype),
         max_depth=cfg.max_depth,
     )
 

@@ -7,11 +7,17 @@ is that pipeline as explicit functional stages over parameter pytrees.
 Stacking fit replicates ``StackingClassifier.fit`` (SURVEY.md §3.2): each
 base member is fitted once on the full data (those become the predict-time
 members), and 5-fold stratified ``cross_val_predict`` produces out-of-fold
-P(class 1) meta-features on which the final LR is trained. Fold fits
-currently run as a host-side loop with per-fold row subsets (two compiled
-shapes — fold sizes differ by ≤1 row); inside each SVC fit, the Platt CV
-sub-solves are vmapped. Fully vmapping the member-level fan-out is tracked
-as a TPU optimization, not done here.
+P(class 1) meta-features on which the final LR is trained.
+
+The fold fan-out is vmapped (SURVEY.md §3.2: the reference's 6× member
+refit is "embarrassingly parallel — in the reference it is strictly
+sequential"): all k fold fits of a member compile to ONE XLA program
+over ``[k, n]`` row masks — masked SVC duals (``svm.svc_fit_masked``),
+mask-parked GBDT growth (``gbdt.fit_folds``), masked FISTA L1-LR — so
+fold parallelism is batch parallelism the hardware already exploits.
+``cross_val_member_probas_loop`` keeps the sequential per-fold-subset
+construction as the differential oracle (tests prove the vmapped path
+matches it).
 """
 
 from __future__ import annotations
@@ -34,7 +40,10 @@ from machine_learning_replications_tpu.models import (
     svm,
     tree,
 )
-from machine_learning_replications_tpu.utils.cv import stratified_kfold_test_masks
+from machine_learning_replications_tpu.utils.cv import (
+    stratified_kfold_test_masks,
+    stratified_kfold_test_masks_within,
+)
 
 
 @flax.struct.dataclass
@@ -63,16 +72,22 @@ def fit_stacking(
         balanced=cfg.svc.class_weight == "balanced",
         probability=cfg.svc.probability,
         platt_cv=cfg.svc.platt_cv,
+        tol=cfg.svc.tol,
+        max_iter=cfg.svc.max_iter,
     )
     gbdt_p, _ = gbdt.fit(np.asarray(X), np.asarray(y), cfg.gbdt)
     lg_p = solvers.logreg_l1_fit(
-        Xj, yj, C=cfg.logreg.C, balanced=cfg.logreg.class_weight == "balanced"
+        Xj, yj, C=cfg.logreg.C, balanced=cfg.logreg.class_weight == "balanced",
+        tol=cfg.logreg.tol, max_iter=cfg.logreg.max_iter,
     )
 
     # --- cross_val_predict meta-features ----------------------------------
     meta_X = cross_val_member_probas(X, y, cfg)
 
-    meta_p = solvers.logreg_l2_fit(jnp.asarray(meta_X), yj, C=cfg.meta.C)
+    meta_p = solvers.logreg_l2_fit(
+        jnp.asarray(meta_X), yj, C=cfg.meta.C,
+        tol=cfg.meta.tol, max_iter=cfg.meta.max_iter,
+    )
 
     return stacking.StackingParams(
         scaler=scaler_p, svc=svc_p, gbdt=gbdt_p, logreg=lg_p, meta=meta_p
@@ -84,7 +99,88 @@ def cross_val_member_probas(
 ) -> np.ndarray:
     """Out-of-fold P(class 1) per member — the ``[n, 3]`` meta-feature matrix
     (sklearn: ``cross_val_predict(est, X, y, cv=5, method='predict_proba')``
-    per member, first column dropped)."""
+    per member, first column dropped) — all k folds of each member as one
+    vmapped XLA program.
+
+    Fold membership is a ``[k, n]`` mask, never a row subset, so every fold
+    shares one static shape (SURVEY.md §7 "fold-size padding with masked
+    reductions"): the SVC fold fit zeroes excluded rows' box constraints
+    (``C_i = 0`` ⇒ α_i = 0), the GBDT fold fit parks them at node −1 with
+    zero gradient, and the L1-LR fold fit zeroes their loss weight.
+    """
+    import jax
+
+    X = np.asarray(X)
+    y = np.asarray(y)
+    k = cfg.stacking.cv_folds
+    test_masks_np = stratified_kfold_test_masks(y, k)
+    train_masks_np = 1.0 - test_masks_np
+
+    Xj = jnp.asarray(X)
+    yj = jnp.asarray(y)
+    dtype = Xj.dtype
+    test_masks = jnp.asarray(test_masks_np, dtype)
+    train_masks = jnp.asarray(train_masks_np, dtype)
+
+    # --- SVC pipeline: fold scaler refit + masked dual + nested Platt CV ---
+    # (sklearn clones the whole Pipeline per fold, so the scaler refits on
+    # the fold's train rows; the nested Platt folds stratify *within* them.)
+    platt_masks = jnp.asarray(
+        np.stack([
+            stratified_kfold_test_masks_within(y, cfg.svc.platt_cv, tm)
+            for tm in train_masks_np
+        ]),
+        dtype,
+    )  # [k, platt_cv, n]
+
+    def one_fold_svc(tm, pm):
+        sp = scaler.fit(Xj, sample_weight=tm)
+        Xt = scaler.transform(sp, Xj)
+        vp = svm.svc_fit_masked(
+            Xt, yj, tm, pm,
+            C=cfg.svc.C,
+            gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
+            balanced=cfg.svc.class_weight == "balanced",
+            tol=cfg.svc.tol, max_iter=cfg.svc.max_iter,
+        )
+        return svm.predict_proba1(vp, Xt)
+
+    p_svc = jax.vmap(one_fold_svc)(train_masks, platt_masks)  # [k, n]
+
+    # --- GBDT: mask-parked fold fits, one program for all k folds ---------
+    gp = gbdt.fit_folds(X, y, train_masks_np, cfg.gbdt)
+    p_gbdt = jax.vmap(lambda p: tree.predict_proba1(p, Xj))(gp)  # [k, n]
+
+    # --- L1 logistic regression: masked FISTA --------------------------
+    def one_fold_lg(tm):
+        lp = solvers.logreg_l1_fit(
+            Xj, yj, C=cfg.logreg.C, sample_mask=tm,
+            balanced=cfg.logreg.class_weight == "balanced",
+            tol=cfg.logreg.tol, max_iter=cfg.logreg.max_iter,
+        )
+        return linear.predict_proba1(lp, Xj)
+
+    p_lg = jax.vmap(one_fold_lg)(train_masks)  # [k, n]
+
+    # Out-of-fold assembly: each row's meta-feature comes from the one fold
+    # whose test mask contains it.
+    meta = jnp.stack(
+        [
+            jnp.sum(p_svc * test_masks, axis=0),
+            jnp.sum(p_gbdt * test_masks, axis=0),
+            jnp.sum(p_lg * test_masks, axis=0),
+        ],
+        axis=1,
+    )
+    return np.asarray(meta)
+
+
+def cross_val_member_probas_loop(
+    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig
+) -> np.ndarray:
+    """Sequential per-fold-subset construction of the same meta-features —
+    the reference's structure (SURVEY.md §3.2) kept as the differential
+    oracle for the vmapped path."""
     X = np.asarray(X)
     y = np.asarray(y)
     n = X.shape[0]
@@ -104,6 +200,8 @@ def cross_val_member_probas(
             balanced=cfg.svc.class_weight == "balanced",
             probability=True,
             platt_cv=cfg.svc.platt_cv,
+            tol=cfg.svc.tol,
+            max_iter=cfg.svc.max_iter,
         )
         meta[te, 0] = np.asarray(
             svm.predict_proba1(vp, scaler.transform(sp, jnp.asarray(Xte)))
@@ -115,6 +213,7 @@ def cross_val_member_probas(
         lp = solvers.logreg_l1_fit(
             jnp.asarray(Xtr), jnp.asarray(ytr), C=cfg.logreg.C,
             balanced=cfg.logreg.class_weight == "balanced",
+            tol=cfg.logreg.tol, max_iter=cfg.logreg.max_iter,
         )
         meta[te, 2] = np.asarray(linear.predict_proba1(lp, jnp.asarray(Xte)))
     return meta

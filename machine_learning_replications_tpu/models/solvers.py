@@ -59,6 +59,37 @@ def _power_lmax(G: jnp.ndarray, iters: int = 30) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _fista_while(prox_step, w0, dtype, tol, max_iter):
+    """Shared accelerated-proximal-gradient driver with residual early exit.
+
+    ``prox_step(z) -> w_new`` is one proximal gradient step from the
+    extrapolated point. Stops when the iterate change falls below
+    ``tol · (1 + ‖w‖∞)`` or at ``max_iter`` (SURVEY.md §5 config row: the
+    round-1 build ran fixed iteration counts and ignored the configured
+    tol/max_iter — under-converging silently at scale, VERDICT.md weak #6).
+    Composes with ``vmap`` (batched lanes run until all converge).
+    """
+
+    def cond(state):
+        _, _, _, it, delta = state
+        return (it < max_iter) & (delta >= tol)
+
+    def body(state):
+        w, z, tk, it, _ = state
+        w_new = prox_step(z)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
+        z = w_new + ((tk - 1.0) / t_new) * (w_new - w)
+        delta = jnp.max(jnp.abs(w_new - w)) / (1.0 + jnp.max(jnp.abs(w_new)))
+        return w_new, z, t_new, it + 1, delta
+
+    state = (
+        w0, w0, jnp.asarray(1.0, dtype),
+        jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, dtype),
+    )
+    w, _, _, n_done, _ = jax.lax.while_loop(cond, body, state)
+    return w, n_done
+
+
 def lasso_fista(
     X: jnp.ndarray,           # [n, F] raw (uncentered)
     y: jnp.ndarray,           # [n]
@@ -66,7 +97,8 @@ def lasso_fista(
     sample_mask: jnp.ndarray, # [n] 1.0 = in this fit
     w0: jnp.ndarray,
     lmax,                     # λmax of (X_cᵀ diag(mask) X_c)/n_eff, precomputed
-    n_iter: int = 250,
+    tol: float = 1e-6,
+    max_iter: int = 1000,
 ) -> jnp.ndarray:
     """Weighted-row Lasso coefficients (no intercept — caller centers).
 
@@ -82,15 +114,11 @@ def lasso_fista(
 
     step = 1.0 / jnp.maximum(lmax, 1e-12)
 
-    def body(_, state):
-        w, z, tk = state
+    def prox_step(z):
         grad = (Xc.T @ (Xc @ z - yc)) / n_eff
-        w_new = soft_threshold(z - step * grad, step * alpha)
-        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
-        z = w_new + ((tk - 1.0) / t_new) * (w_new - w)
-        return w_new, z, t_new
+        return soft_threshold(z - step * grad, step * alpha)
 
-    w, _, _ = jax.lax.fori_loop(0, n_iter, body, (w0, w0, jnp.asarray(1.0, X.dtype)))
+    w, _ = _fista_while(prox_step, w0, X.dtype, tol, max_iter)
     return w
 
 
@@ -110,7 +138,7 @@ def alpha_grid(X: jnp.ndarray, y: jnp.ndarray, n_alphas: int, eps: float) -> jnp
 
 
 def lasso_path(
-    X, y, alphas, sample_mask, n_iter: int = 250
+    X, y, alphas, sample_mask, tol: float = 1e-6, max_iter: int = 1000
 ) -> jnp.ndarray:
     """Warm-started path over a descending alpha grid → coefs ``[A, F]``."""
     n_eff = jnp.sum(sample_mask)
@@ -119,7 +147,7 @@ def lasso_path(
     lmax = _power_lmax(Xc.T @ Xc) / n_eff
 
     def step(w, alpha):
-        w = lasso_fista(X, y, alpha, sample_mask, w, lmax, n_iter)
+        w = lasso_fista(X, y, alpha, sample_mask, w, lmax, tol, max_iter)
         return w, w
 
     w0 = jnp.zeros(X.shape[1], X.dtype)
@@ -127,7 +155,9 @@ def lasso_path(
     return coefs
 
 
-@functools.partial(jax.jit, static_argnames=("cv_folds", "n_alphas", "n_iter"))
+@functools.partial(
+    jax.jit, static_argnames=("cv_folds", "n_alphas", "max_iter")
+)
 def lasso_cv(
     X: jnp.ndarray,
     y: jnp.ndarray,
@@ -135,7 +165,8 @@ def lasso_cv(
     cv_folds: int = 10,
     n_alphas: int = 100,
     eps: float = 1e-3,
-    n_iter: int = 250,
+    tol: float = 1e-6,
+    max_iter: int = 1000,
 ):
     """LassoCV (reference ``train_ensemble_public.py:51``): contiguous
     unshuffled K-folds, shared full-data alpha grid, per-fold held-out MSE,
@@ -156,7 +187,7 @@ def lasso_cv(
     train_masks = 1.0 - test_masks
 
     def fold_mse(train_mask, test_mask):
-        coefs = lasso_path(X, y, alphas, train_mask, n_iter)  # [A, F]
+        coefs = lasso_path(X, y, alphas, train_mask, tol, max_iter)  # [A, F]
         intercepts = jax.vmap(lambda w: lasso_intercept(X, y, w, train_mask))(coefs)
         preds = X @ coefs.T + intercepts[None, :]             # [n, A]
         err2 = (preds - y[:, None]) ** 2 * test_mask[:, None]
@@ -170,7 +201,8 @@ def lasso_cv(
     Xc = X - jnp.mean(X, axis=0)
     lmax = _power_lmax(Xc.T @ Xc) / n
     coef = lasso_fista(
-        X, y, alpha_, full_mask, jnp.zeros(X.shape[1], X.dtype), lmax, 2 * n_iter
+        X, y, alpha_, full_mask, jnp.zeros(X.shape[1], X.dtype), lmax,
+        tol, 2 * max_iter,
     )
     intercept = lasso_intercept(X, y, coef, full_mask)
     return coef, intercept, alpha_, alphas, mse_path
@@ -181,14 +213,15 @@ def lasso_cv(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("balanced", "n_iter"))
+@functools.partial(jax.jit, static_argnames=("balanced", "max_iter"))
 def logreg_l1_fit(
     X: jnp.ndarray,
     y: jnp.ndarray,
     C: float = 1.0,
     sample_mask: jnp.ndarray | None = None,
     balanced: bool = True,
-    n_iter: int = 1500,
+    tol: float = 1e-5,
+    max_iter: int = 2000,
 ) -> LinearParams:
     """liblinear-equivalent L1 logistic regression (bias column penalized)."""
     n, F = X.shape
@@ -207,15 +240,11 @@ def logreg_l1_fit(
         sig = expit(-m)  # d/dm log(1+e^{-m}) = -σ(-m)
         return Xt.T @ (-(C * cw) * sig * s)
 
-    def body(_, state):
-        w, z, tk = state
-        w_new = soft_threshold(z - step * grad_fn(z), step)
-        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
-        z = w_new + ((tk - 1.0) / t_new) * (w_new - w)
-        return w_new, z, t_new
+    def prox_step(z):
+        return soft_threshold(z - step * grad_fn(z), step)
 
     w0 = jnp.zeros(F + 1, X.dtype)
-    w, _, _ = jax.lax.fori_loop(0, n_iter, body, (w0, w0, jnp.asarray(1.0, X.dtype)))
+    w, _ = _fista_while(prox_step, w0, X.dtype, tol, max_iter)
     return LinearParams(coef=w[:F], intercept=w[F])
 
 
@@ -226,17 +255,20 @@ def balanced_class_weights_masked(y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndar
     return jnp.where(y > 0.5, n / (2.0 * n1), n / (2.0 * n0))
 
 
-@functools.partial(jax.jit, static_argnames=("balanced", "n_iter"))
+@functools.partial(jax.jit, static_argnames=("balanced", "max_iter"))
 def logreg_l2_fit(
     X: jnp.ndarray,
     y: jnp.ndarray,
     C: float = 1.0,
     sample_mask: jnp.ndarray | None = None,
     balanced: bool = True,
-    n_iter: int = 60,
+    tol: float = 1e-8,
+    max_iter: int = 60,
 ) -> LinearParams:
     """lbfgs-equivalent L2 logistic regression via damped Newton
-    (dimensions here are tiny — 3 meta-features + intercept)."""
+    (dimensions here are tiny — 3 meta-features + intercept). Stops on the
+    Newton step's ∞-norm (quadratic convergence makes step size a faithful
+    error proxy) or at ``max_iter``."""
     n, F = X.shape
     mask = jnp.ones(n, X.dtype) if sample_mask is None else sample_mask
     cw = (balanced_class_weights_masked(y, mask) if balanced else jnp.ones(n, X.dtype)) * mask
@@ -244,14 +276,24 @@ def logreg_l2_fit(
     s = 2.0 * y - 1.0
     reg = jnp.concatenate([jnp.ones(F, X.dtype), jnp.zeros(1, X.dtype)])  # no bias penalty
 
-    def body(_, w):
+    def cond(state):
+        _, it, delta = state
+        return (it < max_iter) & (delta >= tol)
+
+    def body(state):
+        w, it, _ = state
         m = s * (Xt @ w)
         sig = expit(-m)
         grad = Xt.T @ (-(C * cw) * sig * s) + reg * w
         D = (C * cw) * sig * (1.0 - sig)
         H = Xt.T @ (Xt * D[:, None]) + jnp.diag(reg)
         H = H + 1e-12 * jnp.eye(F + 1, dtype=X.dtype)
-        return w - jnp.linalg.solve(H, grad)
+        step = jnp.linalg.solve(H, grad)
+        return w - step, it + 1, jnp.max(jnp.abs(step))
 
-    w = jax.lax.fori_loop(0, n_iter, body, jnp.zeros(F + 1, X.dtype))
+    w, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.zeros(F + 1, X.dtype), jnp.asarray(0, jnp.int32),
+         jnp.asarray(jnp.inf, X.dtype)),
+    )
     return LinearParams(coef=w[:F], intercept=w[F])

@@ -69,26 +69,34 @@ def cv_sweep(
     sweep: SweepConfig = SweepConfig(),
     base: GBDTConfig = GBDTConfig(),
 ) -> SweepResult:
-    """Run the grid: one fit per (depth, fold), staged evaluation over the
-    ``n_estimators`` axis. Fits with equal fold sizes share compiled
-    programs (fold sizes differ by ≤1 row → ≤2 shapes per depth)."""
+    """Run the grid: ONE vmapped fit per depth covering all folds
+    (``gbdt.fit_folds`` — mask-parked rows, fold axis batched), staged
+    evaluation over the ``n_estimators`` axis. The whole sweep compiles
+    ``len(max_depth_grid)`` programs; the reference-equivalent
+    ``GridSearchCV`` refits every (cell × fold) from scratch."""
+    import jax
+
     X = np.asarray(X)
     y = np.asarray(y)
     est_grid = tuple(sweep.n_estimators_grid)
     depth_grid = tuple(sweep.max_depth_grid)
     m_max = max(est_grid)
     test_masks = stratified_kfold_test_masks(y, sweep.cv_folds)
+    train_masks = 1.0 - test_masks
+    k = sweep.cv_folds
+    Xj = jnp.asarray(X)
 
-    fold_auc = np.zeros((len(depth_grid), len(est_grid), sweep.cv_folds))
+    fold_auc = np.zeros((len(depth_grid), len(est_grid), k))
     for di, depth in enumerate(depth_grid):
         cfg = dataclasses.replace(base, n_estimators=m_max, max_depth=depth)
-        for k, tm in enumerate(test_masks):
-            tr = tm < 0.5
-            te = ~tr
-            params, _ = gbdt.fit(X[tr], y[tr], cfg)
-            p = staged_proba1(params, jnp.asarray(X[te]), est_grid)
+        params = gbdt.fit_folds(X, y, train_masks, cfg)
+        probs = np.asarray(
+            jax.vmap(lambda p: staged_proba1(p, Xj, est_grid))(params)
+        )  # [k, n_estimators, n]
+        for kk, tm in enumerate(test_masks):
+            te = tm > 0.5
             for ei in range(len(est_grid)):
-                fold_auc[di, ei, k] = float(metrics.roc_auc(y[te], p[ei]))
+                fold_auc[di, ei, kk] = float(metrics.roc_auc(y[te], probs[kk, ei, te]))
 
     mean_auc = fold_auc.mean(axis=-1)
     di, ei = np.unravel_index(np.argmax(mean_auc), mean_auc.shape)

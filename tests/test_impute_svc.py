@@ -81,7 +81,7 @@ def test_svc_fit_decision_parity(svc_data):
         sk = SVC(class_weight="balanced", probability=True, random_state=2020).fit(
             np.asarray(Xt), y
         )
-    ours = svm.svc_fit(Xt, jnp.asarray(y), n_iter=4000)
+    ours = svm.svc_fit(Xt, jnp.asarray(y), tol=1e-7, max_iter=4000)
     np.testing.assert_allclose(float(ours.gamma), sk._gamma, rtol=1e-9)
 
     dec_sk = sk.decision_function(np.asarray(Xt))
@@ -109,7 +109,7 @@ def test_trim_support(svc_data):
     X, y = svc_data
     sp = scaler.fit(jnp.asarray(X))
     Xt = scaler.transform(sp, jnp.asarray(X))
-    full = svm.svc_fit(Xt, jnp.asarray(y), probability=False, n_iter=2000)
+    full = svm.svc_fit(Xt, jnp.asarray(y), probability=False, tol=1e-7, max_iter=2000)
     trimmed = svm.trim_support(full)
     assert trimmed.support_vectors.shape[0] < Xt.shape[0]
     np.testing.assert_allclose(

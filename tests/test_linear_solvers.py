@@ -45,7 +45,7 @@ def test_lasso_single_fit(lin_data):
     lmax = solvers._power_lmax(Xc.T @ Xc) / X.shape[0]
     w = solvers.lasso_fista(
         jnp.asarray(X), jnp.asarray(y), alpha, full,
-        jnp.zeros(X.shape[1]), lmax, n_iter=800,
+        jnp.zeros(X.shape[1]), lmax, tol=1e-10, max_iter=800,
     )
     b = solvers.lasso_intercept(jnp.asarray(X), jnp.asarray(y), w, full)
     np.testing.assert_allclose(np.asarray(w), sk.coef_, atol=2e-5)
@@ -58,7 +58,7 @@ def test_lasso_cv_matches_sklearn(lin_data):
     X, y = lin_data
     sk = LassoCV(cv=10, random_state=2020, tol=1e-8, max_iter=20_000).fit(X, y)
     coef, intercept, alpha_, alphas, mse_path = solvers.lasso_cv(
-        jnp.asarray(X), jnp.asarray(y), cv_folds=10, n_iter=400
+        jnp.asarray(X), jnp.asarray(y), cv_folds=10, max_iter=400
     )
     np.testing.assert_allclose(float(alpha_), sk.alpha_, rtol=1e-9)
     np.testing.assert_allclose(np.asarray(mse_path), sk.mse_path_, rtol=2e-4, atol=1e-6)
@@ -89,7 +89,7 @@ def test_logreg_l1_matches_liblinear(lin_data):
     sk = LogisticRegression(
         class_weight="balanced", penalty="l1", solver="liblinear", tol=1e-8, max_iter=5000
     ).fit(X, yb)
-    ours = solvers.logreg_l1_fit(jnp.asarray(X), jnp.asarray(yb), n_iter=4000)
+    ours = solvers.logreg_l1_fit(jnp.asarray(X), jnp.asarray(yb), tol=1e-8, max_iter=4000)
     np.testing.assert_allclose(np.asarray(ours.coef), sk.coef_[0], atol=2e-3)
     np.testing.assert_allclose(float(ours.intercept), sk.intercept_[0], atol=2e-3)
 

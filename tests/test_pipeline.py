@@ -43,6 +43,43 @@ def _sklearn_reference_pipeline(X_dev, y_dev, X_sel):
     return clf.predict_proba(X_sel[:, sup])[:, 1], sup
 
 
+def test_vmapped_meta_features_match_loop(cohort_full):
+    """The vmapped fold fan-out (one XLA program per member for all k
+    folds — ``svc_fit_masked`` / ``gbdt.fit_folds`` / masked FISTA) must
+    reproduce the sequential per-fold-subset construction it replaced
+    (VERDICT.md round-1 item 3). Differences are solver-path-level only:
+    the masked SVC dual solves the same convex QP with a different step
+    size (full-matrix λmax vs subset λmax), and the fold GBDT bins on the
+    full matrix's candidate superset."""
+    from machine_learning_replications_tpu.data.schema import selected_indices
+
+    X, y, _ = cohort_full
+    # The contractual 17 columns — what fit_stacking actually feeds the fold
+    # fan-out. (On heavily continuous columns the GBDT fold fits can pick
+    # different near-tied splits, because fit_folds bins candidates on the
+    # full matrix while the loop enumerates per-fold exact midpoints — a
+    # documented semantic of the masked path, not a bug; see
+    # gbdt.fit_folds' docstring.)
+    Xs = np.asarray(X[:400, selected_indices()])
+    ys = np.asarray(y[:400])
+    from machine_learning_replications_tpu.config import SVCConfig
+
+    # Tight dual tolerance: the two paths take different iterate trajectories
+    # (full-matrix vs subset step size), so comparing them near the shared
+    # optimum needs both to actually reach it.
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=25), svc=SVCConfig(platt_cv=3, tol=3e-7)
+    )
+    meta_v = pipeline.cross_val_member_probas(Xs, ys, cfg)
+    meta_l = pipeline.cross_val_member_probas_loop(Xs, ys, cfg)
+    d = np.abs(meta_v - meta_l)
+    assert d[:, 0].max() < 6e-3, f"svc meta diff {d[:, 0].max()}"   # dual-solver path
+    assert d[:, 1].max() < 6e-3, f"gbdt meta diff {d[:, 1].max()}"  # bin superset
+    assert d[:, 2].max() < 1e-7, f"logreg meta diff {d[:, 2].max()}"
+    # probabilities, not garbage
+    assert ((meta_v > 0) & (meta_v < 1)).all()
+
+
 @pytest.mark.slow
 def test_full_pipeline_auc_parity(cohort_full):
     from sklearn.metrics import roc_auc_score
