@@ -66,34 +66,110 @@ def restore_params(path: str | os.PathLike, template: Any) -> Any:
         return ckptr.restore(os.path.abspath(os.fspath(path)), template)
 
 
-_TEMPLATE_FILE = "pytree_template.pkl"
+_TEMPLATE_FILE = "pytree_template.json"
+
+
+def _class_registry() -> dict[str, type]:
+    """The closed set of param pytree classes a sidecar may name. Keyed by
+    class name so the sidecar can be plain JSON — loading a checkpoint can
+    only ever instantiate these, never run code from the checkpoint dir
+    (the reason the sidecar is NOT a pickle: ``predict --model <dir>`` on an
+    untrusted directory must not be an arbitrary-code-execution vector,
+    matching ``sklearn_import``'s decode-without-executing design)."""
+    from machine_learning_replications_tpu.models import (
+        knn_impute, linear, pipeline, scaler, stacking, svm, tree,
+    )
+
+    classes = [
+        pipeline.PipelineParams,
+        stacking.StackingParams,
+        scaler.ScalerParams,
+        svm.SVCParams,
+        tree.TreeEnsembleParams,
+        linear.LinearParams,
+        knn_impute.KNNImputerParams,
+    ]
+    return {c.__name__: c for c in classes}
+
+
+def _encode_template(node: Any) -> Any:
+    """Pytree → JSON-able sidecar node (shapes/dtypes/statics only)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        cls_name = type(node).__name__
+        if cls_name not in _class_registry():
+            raise TypeError(
+                f"cannot sidecar {cls_name}: not in the checkpoint class registry"
+            )
+        return {
+            "cls": cls_name,
+            "fields": {
+                f.name: _encode_template(getattr(node, f.name))
+                for f in dataclasses.fields(node)
+            },
+        }
+    if isinstance(node, (jax.Array, np.ndarray, jax.ShapeDtypeStruct, np.generic)):
+        arr = jnp.asarray(node) if isinstance(node, np.generic) else node
+        return {"array": {"shape": list(arr.shape), "dtype": str(np.dtype(arr.dtype))}}
+    if isinstance(node, (tuple, list)):
+        return {"seq": [_encode_template(x) for x in node],
+                "tuple": isinstance(node, tuple)}
+    if node is None or isinstance(node, (bool, int, float, str)):
+        return {"static": node}
+    raise TypeError(f"cannot sidecar a {type(node).__name__} leaf")
+
+
+def _decode_template(node: Any) -> Any:
+    """Sidecar node → abstract template pytree (ShapeDtypeStruct leaves)."""
+    import numpy as np
+
+    if "cls" in node:
+        cls = _class_registry()[node["cls"]]
+        kwargs = {k: _decode_template(v) for k, v in node["fields"].items()}
+        return cls(**kwargs)
+    if "array" in node:
+        spec = node["array"]
+        return jax.ShapeDtypeStruct(tuple(spec["shape"]), np.dtype(spec["dtype"]))
+    if "seq" in node:
+        items = [_decode_template(x) for x in node["seq"]]
+        return tuple(items) if node.get("tuple", True) else items
+    if "static" in node:
+        return node["static"]
+    raise ValueError(f"malformed sidecar node: {sorted(node)}")
 
 
 def save_model(path: str | os.PathLike, params: Any) -> None:
     """``save_params`` plus a self-describing sidecar so the checkpoint can
     be restored *without* the caller reconstructing a template pytree (the
-    CLI's load path). The sidecar pickles only ``jax.ShapeDtypeStruct``
-    leaves inside the params' own dataclass structure — written and read
-    exclusively by this module, never by sklearn-era code."""
-    import pickle
+    CLI's load path). The sidecar is JSON: the params' dataclass structure
+    by *name* (resolved against a fixed registry at load) plus shape/dtype
+    per array leaf and plain values for static fields."""
+    import json
 
     path = os.path.abspath(os.fspath(path))
     save_params(path, params)
-    template = abstract_like(params, keep_sharding=False)
-    with open(os.path.join(path, _TEMPLATE_FILE), "wb") as f:
-        pickle.dump(template, f)
+    sidecar = {"format": 1, "root": _encode_template(params)}
+    with open(os.path.join(path, _TEMPLATE_FILE), "w") as f:
+        json.dump(sidecar, f, indent=1)
 
 
 def load_model(path: str | os.PathLike) -> Any:
-    """Restore a checkpoint written by ``save_model`` using its sidecar
-    template. Arrays land on the default device; re-shard afterwards for
-    mesh use (``data.shard_rows`` / ``NamedSharding``)."""
-    import pickle
+    """Restore a checkpoint written by ``save_model`` using its JSON sidecar
+    template (no code from the checkpoint directory ever runs). Arrays land
+    on the default device; re-shard afterwards for mesh use
+    (``data.shard_rows`` / ``NamedSharding``)."""
+    import json
 
     path = os.path.abspath(os.fspath(path))
-    with open(os.path.join(path, _TEMPLATE_FILE), "rb") as f:
-        template = pickle.load(f)
-    return restore_params(path, template)
+    with open(os.path.join(path, _TEMPLATE_FILE)) as f:
+        sidecar = json.load(f)
+    if sidecar.get("format") != 1:
+        raise ValueError(f"unknown sidecar format {sidecar.get('format')!r}")
+    return restore_params(path, _decode_template(sidecar["root"]))
 
 
 def boosting_manager(

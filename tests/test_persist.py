@@ -63,6 +63,45 @@ def test_stacking_roundtrip_from_reference_pkl(tmp_path):
     )
 
 
+def test_save_model_sidecar_is_json_not_pickle(tmp_path):
+    """``predict --model <dir>`` must never execute code from the model dir:
+    the self-describing sidecar is JSON resolved against a fixed class
+    registry (ADVICE.md round 1: the pickle sidecar was an arbitrary-code-
+    execution vector on untrusted checkpoint directories)."""
+    import json
+    import os
+
+    params = import_stacking(decode_pickle(REFERENCE_PKL_PATH))
+    path = tmp_path / "model"
+    orbax_io.save_model(path, params)
+    files = os.listdir(path)
+    assert not any(f.endswith(".pkl") for f in files), files
+    with open(path / "pytree_template.json") as f:
+        sidecar = json.load(f)  # must parse as plain JSON
+    assert sidecar["root"]["cls"] == "StackingParams"
+
+    restored = orbax_io.load_model(path)
+    assert type(restored).__name__ == "StackingParams"
+    X = np.random.default_rng(7).normal(size=(16, 17))
+    np.testing.assert_array_equal(
+        np.asarray(stacking.predict_proba(restored, X)),
+        np.asarray(stacking.predict_proba(params, X)),
+    )
+
+
+def test_save_model_roundtrip_forest_statics(tmp_path, fitted_forest):
+    """The sidecar carries non-array statics (max_depth) through JSON."""
+    Xs, _, _, params, _ = fitted_forest
+    path = tmp_path / "forest_model"
+    orbax_io.save_model(path, params)
+    restored = orbax_io.load_model(path)
+    assert restored.max_depth == params.max_depth
+    np.testing.assert_allclose(
+        np.asarray(tree.predict_proba1(restored, Xs)),
+        np.asarray(tree.predict_proba1(params, Xs)),
+    )
+
+
 def test_resumable_equals_unbroken(tmp_path, fitted_forest):
     Xs, y, cfg, params, aux = fitted_forest
     ckdir = tmp_path / "ck"
