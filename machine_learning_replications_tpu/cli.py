@@ -59,6 +59,34 @@ def _config(args):
     return ExperimentConfig()
 
 
+def _build_mesh(args):
+    """--mesh 'DATA[,MODEL]' or 'auto' → a device mesh (None without the
+    flag). --distributed first brings up the multi-host runtime so the mesh
+    spans every host's chips (single host: a harmless no-op)."""
+    if args.distributed:
+        from machine_learning_replications_tpu.parallel.distributed import (
+            initialize_distributed,
+        )
+
+        up = initialize_distributed()
+        print(
+            "distributed runtime " + ("up" if up else "unavailable (single host)"),
+            file=sys.stderr,
+        )
+    if not args.mesh:
+        return None
+    from machine_learning_replications_tpu.parallel import make_mesh
+
+    if args.mesh == "auto":
+        return make_mesh()
+    parts = [int(p) for p in args.mesh.split(",")]
+    if len(parts) == 1:
+        parts.append(1)
+    if len(parts) != 2:
+        raise SystemExit(f"--mesh expects DATA[,MODEL] or 'auto', got {args.mesh!r}")
+    return make_mesh(data=parts[0], model=parts[1])
+
+
 def cmd_train(args) -> int:
     import jax.numpy as jnp
 
@@ -66,10 +94,16 @@ def cmd_train(args) -> int:
     from machine_learning_replications_tpu.utils import metrics
 
     cfg = _config(args)
+    mesh = _build_mesh(args)
+    if mesh is not None:
+        print(
+            f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}",
+            file=sys.stderr,
+        )
     X_dev, y_dev = _load_cohort(args, "develop")
     X_sel, y_sel = _load_cohort(args, "select")
 
-    params, info = pipeline.fit_pipeline(X_dev, y_dev, cfg)
+    params, info = pipeline.fit_pipeline(X_dev, y_dev, cfg, mesh=mesh)
     print(f"selected {info['n_selected']} features", file=sys.stderr)
 
     p1 = np.asarray(pipeline.pipeline_predict_proba1(params, X_sel))
@@ -243,6 +277,16 @@ def build_parser() -> argparse.ArgumentParser:
     add_cohort_flags(t)
     t.add_argument("--save", help="Orbax checkpoint directory to write")
     t.add_argument("--plots", help="directory for roc.png / pr.png")
+    t.add_argument(
+        "--mesh", default=None,
+        help="device-mesh shape DATA[,MODEL] (e.g. 8 or 4,2) or 'auto' "
+        "(all devices on the data axis); routes the GBDT member through "
+        "the row-sharded trainers",
+    )
+    t.add_argument(
+        "--distributed", action="store_true",
+        help="bring up jax.distributed (multi-host) before building the mesh",
+    )
     t.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("predict", help="single-patient inference")

@@ -45,7 +45,15 @@ class GBDTConfig:
 
 @dataclasses.dataclass(frozen=True)
 class SVCConfig:
-    """RBF support-vector member (reference: ``train_ensemble_public.py:44``)."""
+    """RBF support-vector member (reference: ``train_ensemble_public.py:44``).
+
+    Scaled-regime policy (SURVEY.md §7 "SVC on TPU"): the kernel matrix is
+    O(n²), so above ``max_rows`` fit rows the member either trains on a
+    deterministic stratified subsample of ``max_rows`` rows
+    (``scale_policy='subsample'`` — the default; the GBDT/LR members still
+    see every row, and they dominate the meta weights anyway, SURVEY.md
+    §2.3) or refuses with a clear error (``scale_policy='error'``).
+    """
 
     C: float = 1.0
     gamma: str | float = "scale"  # 'scale' → 1 / (n_features * X.var())
@@ -54,6 +62,9 @@ class SVCConfig:
     platt_cv: int = 5
     tol: float = 1e-3
     max_iter: int = 20_000
+    max_rows: int = 20_000
+    scale_policy: str = "subsample"  # 'subsample' | 'error'
+    predict_chunk_rows: int = 65_536  # bound the [chunk, n_sv] kernel at predict
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,9 +92,17 @@ class LassoSelectConfig:
 
 @dataclasses.dataclass(frozen=True)
 class ImputerConfig:
-    """KNN imputation (reference: ``train_ensemble_public.py:37``)."""
+    """KNN imputation (reference: ``train_ensemble_public.py:37``).
+
+    Scaled-regime policy: the donor distance matrix is O(n_query · n_fit),
+    so the fit cohort is capped at ``max_donors`` rows (deterministic
+    uniform subsample — 1-NN imputation quality saturates long before 10⁵
+    donors) and ``transform`` processes queries in ``chunk_rows`` blocks.
+    """
 
     n_neighbors: int = 1
+    max_donors: int = 100_000
+    chunk_rows: int = 8_192
 
 
 @dataclasses.dataclass(frozen=True)

@@ -13,6 +13,12 @@ on the development cohort and applied to both cohorts
 
 Functional API: ``fit`` captures the donor matrix; ``transform`` is pure and
 jittable (static feature count drives an unrolled per-feature argmin).
+
+Scaled regime (``ImputerConfig``): the distance matrix is
+O(n_query · n_fit), so ``fit`` caps the donor cohort at ``max_donors`` rows
+(deterministic uniform subsample) and ``transform`` processes queries in
+``chunk_rows`` blocks — each block one compiled program, the tail block
+zero-padded to the shared shape.
 """
 
 from __future__ import annotations
@@ -20,7 +26,9 @@ from __future__ import annotations
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from machine_learning_replications_tpu.config import ImputerConfig
 from machine_learning_replications_tpu.ops.linalg import masked_pairwise_sq_dists
 
 
@@ -30,15 +38,27 @@ class KNNImputerParams:
     col_means: jnp.ndarray  # [F] — nan-mean fallback per column
 
 
-def fit(X_fit: jnp.ndarray) -> KNNImputerParams:
-    X_fit = jnp.asarray(X_fit)
+def fit(
+    X_fit: jnp.ndarray, cfg: ImputerConfig = ImputerConfig(), seed: int = 2020
+) -> KNNImputerParams:
+    X_np = np.asarray(X_fit)
+    if X_np.shape[0] > cfg.max_donors:
+        keep = np.sort(
+            np.random.default_rng(seed).choice(
+                X_np.shape[0], size=cfg.max_donors, replace=False
+            )
+        )
+        donors = jnp.asarray(X_np[keep])
+    else:
+        donors = jnp.asarray(X_fit)
+    # Fallback means come from the FULL fit cohort (cheap; one pass).
     return KNNImputerParams(
-        donors=X_fit, col_means=jnp.nanmean(X_fit, axis=0)
+        donors=donors, col_means=jnp.asarray(np.nanmean(X_np, axis=0))
     )
 
 
 @jax.jit
-def transform(params: KNNImputerParams, X: jnp.ndarray) -> jnp.ndarray:
+def _transform_block(params: KNNImputerParams, X: jnp.ndarray) -> jnp.ndarray:
     """Impute every NaN in ``X[nq, F]`` from the nearest eligible donor."""
     X = jnp.asarray(X)
     D = masked_pairwise_sq_dists(X, params.donors)      # [nq, n_fit]
@@ -57,6 +77,30 @@ def transform(params: KNNImputerParams, X: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(out_cols, axis=1)
 
 
-def fit_transform(X_fit: jnp.ndarray) -> tuple[KNNImputerParams, jnp.ndarray]:
-    params = fit(X_fit)
-    return params, transform(params, X_fit)
+def transform(
+    params: KNNImputerParams, X: jnp.ndarray, chunk_rows: int | None = None
+) -> jnp.ndarray:
+    """``_transform_block`` over query chunks; single block when the query
+    fits (``chunk_rows=None`` → ``ImputerConfig().chunk_rows``)."""
+    chunk = ImputerConfig().chunk_rows if chunk_rows is None else chunk_rows
+    n = int(X.shape[0])
+    if n <= chunk:
+        return _transform_block(params, X)
+    X_np = np.asarray(X)
+    blocks = []
+    for s in range(0, n, chunk):
+        block = X_np[s : s + chunk]
+        real = block.shape[0]
+        if real < chunk:  # pad the tail so every block shares one shape
+            block = np.pad(
+                block, ((0, chunk - real), (0, 0)), constant_values=np.nan
+            )
+        blocks.append(np.asarray(_transform_block(params, jnp.asarray(block)))[:real])
+    return jnp.asarray(np.concatenate(blocks, axis=0))
+
+
+def fit_transform(
+    X_fit: jnp.ndarray, cfg: ImputerConfig = ImputerConfig(), seed: int = 2020
+) -> tuple[KNNImputerParams, jnp.ndarray]:
+    params = fit(X_fit, cfg, seed)
+    return params, transform(params, X_fit, cfg.chunk_rows)

@@ -56,17 +56,36 @@ class PipelineParams:
 
 
 def fit_stacking(
-    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig = ExperimentConfig()
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: ExperimentConfig = ExperimentConfig(),
+    mesh=None,
 ) -> stacking.StackingParams:
-    """Fit the stacking ensemble on (already imputed + selected) ``X[n, 17]``."""
+    """Fit the stacking ensemble on (already imputed + selected) ``X[n, 17]``.
+
+    Above ``cfg.svc.max_rows`` rows the SVC member (O(n² ) kernel matrix)
+    follows ``cfg.svc.scale_policy``: a deterministic stratified subsample
+    of ``max_rows`` rows (scaler included — it lives inside the member's
+    pipeline), or a refusal with a clear message. The GBDT and LR members
+    always train on every row (they scale), and they carry the dominant
+    meta weights (SURVEY.md §2.3: 1.837 + 2.880 vs the SVC's 0.410).
+
+    With ``mesh`` (a ``jax.sharding.Mesh``), the GBDT member trains through
+    the row-sharded trainers (``parallel.fit_gbdt_sharded`` — histogram
+    partials psum over the 'data' axis); a 1-device mesh is the same code
+    path (BASELINE config 5's contract).
+    """
     Xj = jnp.asarray(X)
     yj = jnp.asarray(y)
 
     # --- full-data member fits (the predict-time estimators_) -------------
-    scaler_p = scaler.fit(Xj)
+    svc_rows = _svc_fit_rows(y, cfg, fold=None)
+    Xsvc = Xj if svc_rows is None else Xj[svc_rows]
+    ysvc = yj if svc_rows is None else yj[svc_rows]
+    scaler_p = scaler.fit(Xsvc)
     svc_p = svm.svc_fit(
-        scaler.transform(scaler_p, Xj),
-        yj,
+        scaler.transform(scaler_p, Xsvc),
+        ysvc,
         C=cfg.svc.C,
         gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
         balanced=cfg.svc.class_weight == "balanced",
@@ -75,7 +94,12 @@ def fit_stacking(
         tol=cfg.svc.tol,
         max_iter=cfg.svc.max_iter,
     )
-    gbdt_p, _ = gbdt.fit(np.asarray(X), np.asarray(y), cfg.gbdt)
+    if mesh is not None:
+        from machine_learning_replications_tpu.parallel import fit_gbdt_sharded
+
+        gbdt_p, _ = fit_gbdt_sharded(mesh, np.asarray(X), np.asarray(y), cfg.gbdt)
+    else:
+        gbdt_p, _ = gbdt.fit(np.asarray(X), np.asarray(y), cfg.gbdt)
     lg_p = solvers.logreg_l1_fit(
         Xj, yj, C=cfg.logreg.C, balanced=cfg.logreg.class_weight == "balanced",
         tol=cfg.logreg.tol, max_iter=cfg.logreg.max_iter,
@@ -92,6 +116,34 @@ def fit_stacking(
     return stacking.StackingParams(
         scaler=scaler_p, svc=svc_p, gbdt=gbdt_p, logreg=lg_p, meta=meta_p
     )
+
+
+def _svc_fit_rows(
+    y: np.ndarray, cfg: ExperimentConfig, fold: int | None
+) -> np.ndarray | None:
+    """Scaled-regime guard for the SVC member: None (all rows fit), sorted
+    subsample indices, or a refusal per ``cfg.svc.scale_policy``."""
+    n = np.asarray(y).shape[0]
+    if n <= cfg.svc.max_rows:
+        return None
+    if cfg.svc.scale_policy == "error":
+        raise RuntimeError(
+            f"SVC member: {n} rows exceeds SVCConfig.max_rows="
+            f"{cfg.svc.max_rows} (the RBF kernel matrix is O(n²)); set "
+            "scale_policy='subsample' (stratified subsample, default), "
+            "raise max_rows, or drop the SVC member"
+        )
+    if cfg.svc.scale_policy != "subsample":
+        raise ValueError(
+            f"unknown SVCConfig.scale_policy {cfg.svc.scale_policy!r}; "
+            "expected 'subsample' or 'error'"
+        )
+    from machine_learning_replications_tpu.utils.cv import (
+        stratified_subsample_indices,
+    )
+
+    seed = cfg.seed if fold is None else cfg.seed + 1 + fold
+    return stratified_subsample_indices(y, cfg.svc.max_rows, seed=seed)
 
 
 def cross_val_member_probas(
@@ -112,9 +164,12 @@ def cross_val_member_probas(
 
     X = np.asarray(X)
     y = np.asarray(y)
+    n = X.shape[0]
     k = cfg.stacking.cv_folds
     test_masks_np = stratified_kfold_test_masks(y, k)
     train_masks_np = 1.0 - test_masks_np
+    if n > cfg.svc.max_rows:
+        _svc_fit_rows(y, cfg, fold=0)  # policy check (may raise)
 
     Xj = jnp.asarray(X)
     yj = jnp.asarray(y)
@@ -125,27 +180,38 @@ def cross_val_member_probas(
     # --- SVC pipeline: fold scaler refit + masked dual + nested Platt CV ---
     # (sklearn clones the whole Pipeline per fold, so the scaler refits on
     # the fold's train rows; the nested Platt folds stratify *within* them.)
-    platt_masks = jnp.asarray(
-        np.stack([
-            stratified_kfold_test_masks_within(y, cfg.svc.platt_cv, tm)
-            for tm in train_masks_np
-        ]),
-        dtype,
-    )  # [k, platt_cv, n]
-
-    def one_fold_svc(tm, pm):
-        sp = scaler.fit(Xj, sample_weight=tm)
-        Xt = scaler.transform(sp, Xj)
-        vp = svm.svc_fit_masked(
-            Xt, yj, tm, pm,
-            C=cfg.svc.C,
-            gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
-            balanced=cfg.svc.class_weight == "balanced",
-            tol=cfg.svc.tol, max_iter=cfg.svc.max_iter,
+    if n > cfg.svc.max_rows:
+        # Scaled regime: the masked path still materializes the full [n, n]
+        # kernel, so fold fits move to physical stratified subsets of
+        # ``max_rows`` rows each (one static shape → still one vmapped
+        # program) with chunked out-of-fold prediction.
+        svc_oof = jnp.asarray(
+            _svc_oof_subsampled(X, y, test_masks_np, train_masks_np, cfg),
+            dtype,
         )
-        return svm.predict_proba1(vp, Xt)
+    else:
+        platt_masks = jnp.asarray(
+            np.stack([
+                stratified_kfold_test_masks_within(y, cfg.svc.platt_cv, tm)
+                for tm in train_masks_np
+            ]),
+            dtype,
+        )  # [k, platt_cv, n]
 
-    p_svc = jax.vmap(one_fold_svc)(train_masks, platt_masks)  # [k, n]
+        def one_fold_svc(tm, pm):
+            sp = scaler.fit(Xj, sample_weight=tm)
+            Xt = scaler.transform(sp, Xj)
+            vp = svm.svc_fit_masked(
+                Xt, yj, tm, pm,
+                C=cfg.svc.C,
+                gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
+                balanced=cfg.svc.class_weight == "balanced",
+                tol=cfg.svc.tol, max_iter=cfg.svc.max_iter,
+            )
+            return svm.predict_proba1(vp, Xt)
+
+        p_svc = jax.vmap(one_fold_svc)(train_masks, platt_masks)  # [k, n]
+        svc_oof = jnp.sum(p_svc * test_masks, axis=0)
 
     # --- GBDT: mask-parked fold fits, one program for all k folds ---------
     gp = gbdt.fit_folds(X, y, train_masks_np, cfg.gbdt)
@@ -166,13 +232,77 @@ def cross_val_member_probas(
     # whose test mask contains it.
     meta = jnp.stack(
         [
-            jnp.sum(p_svc * test_masks, axis=0),
+            svc_oof,
             jnp.sum(p_gbdt * test_masks, axis=0),
             jnp.sum(p_lg * test_masks, axis=0),
         ],
         axis=1,
     )
     return np.asarray(meta)
+
+
+def _svc_oof_subsampled(
+    X: np.ndarray,
+    y: np.ndarray,
+    test_masks_np: np.ndarray,
+    train_masks_np: np.ndarray,
+    cfg: ExperimentConfig,
+) -> np.ndarray:
+    """Out-of-fold SVC probabilities in the scaled regime: each fold fits on
+    a stratified ``max_rows`` subset of its train rows (all folds share one
+    shape, so the k fits still vmap into one program); test rows are scored
+    against the fold's support set in bounded-memory chunks."""
+    import jax
+
+    from machine_learning_replications_tpu.utils.cv import (
+        stratified_kfold_test_masks,
+        stratified_subsample_indices,
+    )
+
+    k = len(test_masks_np)
+    m = cfg.svc.max_rows
+    idxs = np.stack([
+        stratified_subsample_indices(
+            y, m, rows=np.where(train_masks_np[j] > 0.5)[0],
+            seed=cfg.seed + 1 + j,
+        )
+        for j in range(k)
+    ])  # [k, m]
+    Xsub = jnp.asarray(X[idxs])   # [k, m, F]
+    ysub = jnp.asarray(y[idxs])
+    dtype = Xsub.dtype
+    platt = jnp.asarray(
+        np.stack([
+            stratified_kfold_test_masks(y[idxs[j]], cfg.svc.platt_cv)
+            for j in range(k)
+        ]),
+        dtype,
+    )  # [k, platt_cv, m]
+    full = jnp.ones((k, m), dtype)
+
+    def one_fold(Xs_, ys_, fm, pm):
+        sp = scaler.fit(Xs_)
+        vp = svm.svc_fit_masked(
+            scaler.transform(sp, Xs_), ys_, fm, pm,
+            C=cfg.svc.C,
+            gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
+            balanced=cfg.svc.class_weight == "balanced",
+            tol=cfg.svc.tol, max_iter=cfg.svc.max_iter,
+        )
+        return sp, vp
+
+    sps, vps = jax.vmap(one_fold)(Xsub, ysub, full, platt)
+
+    oof = np.zeros(y.shape[0])
+    for j in range(k):  # host loop: k is 5; the chunked predict dominates
+        spj = jax.tree.map(lambda a: a[j], sps)
+        vpj = jax.tree.map(lambda a: a[j], vps)
+        te = test_masks_np[j] > 0.5
+        Xte = np.asarray(scaler.transform(spj, jnp.asarray(X[te])))
+        oof[te] = svm.predict_proba1_chunked(
+            vpj, Xte, cfg.svc.predict_chunk_rows
+        )
+    return oof
 
 
 def cross_val_member_probas_loop(
@@ -220,17 +350,23 @@ def cross_val_member_probas_loop(
 
 
 def fit_pipeline(
-    X64: np.ndarray, y: np.ndarray, cfg: ExperimentConfig = ExperimentConfig()
+    X64: np.ndarray,
+    y: np.ndarray,
+    cfg: ExperimentConfig = ExperimentConfig(),
+    mesh=None,
 ) -> tuple[PipelineParams, dict[str, Any]]:
     """The full reference program: impute → select → stack.
 
     ``X64`` is the raw 64-variable cohort (NaNs allowed); returns fitted
-    params plus selection diagnostics.
+    params plus selection diagnostics. ``mesh`` routes the GBDT member
+    through the sharded trainers (see ``fit_stacking``).
     """
-    imp_p, X_imp = knn_impute.fit_transform(jnp.asarray(X64))
+    imp_p, X_imp = knn_impute.fit_transform(
+        jnp.asarray(X64), cfg.imputer, cfg.seed
+    )
     X_imp = np.asarray(X_imp)
     mask, info = feature_selection.fit_select(X_imp, y, cfg.select)
-    ens = fit_stacking(X_imp[:, mask], y, cfg)
+    ens = fit_stacking(X_imp[:, mask], y, cfg, mesh=mesh)
     return (
         PipelineParams(
             imputer=imp_p, support_mask=jnp.asarray(mask), ensemble=ens

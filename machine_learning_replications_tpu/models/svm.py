@@ -106,6 +106,32 @@ def predict_proba1(params: SVCParams, Xt: jnp.ndarray) -> jnp.ndarray:
     return _binary_coupling(r0)
 
 
+_predict_proba1_jit = jax.jit(predict_proba1)
+
+
+def predict_proba1_chunked(
+    params: SVCParams, Xt, chunk_rows: int = 65_536
+) -> np.ndarray:
+    """``predict_proba1`` over row chunks, bounding the ``[chunk, n_sv]``
+    kernel block in memory (the scaled-regime predict path — at 10M rows a
+    single kernel evaluation against even a trimmed support set would not
+    fit). The last chunk is zero-padded so every block shares one compiled
+    shape. Host-side by design: returns numpy."""
+    Xt = np.asarray(Xt)
+    n = Xt.shape[0]
+    if n <= chunk_rows:
+        return np.asarray(_predict_proba1_jit(params, jnp.asarray(Xt)))
+    out = np.empty(n, dtype=Xt.dtype)
+    for s in range(0, n, chunk_rows):
+        block = Xt[s : s + chunk_rows]
+        if block.shape[0] < chunk_rows:  # pad the tail to the shared shape
+            block = np.pad(block, ((0, chunk_rows - block.shape[0]), (0, 0)))
+        out[s : s + chunk_rows] = np.asarray(
+            _predict_proba1_jit(params, jnp.asarray(block))
+        )[: n - s]
+    return out
+
+
 def predict_proba1_sigmoid(params: SVCParams, Xt: jnp.ndarray) -> jnp.ndarray:
     """Closed-form Platt probability (the coupling fixed point).
 

@@ -25,12 +25,24 @@ from machine_learning_replications_tpu.parallel import (
     stump_trainer,
 )
 
+
+def fit_gbdt_sharded(mesh, X, y, cfg):
+    """Mesh-sharded GBDT fit, dispatching like ``models.gbdt.fit``: the
+    replicated-sorted stump trainer at depth 1 (sklearn-exact splits, rows
+    over 'data', feature tiles over 'model'), the level-wise histogram
+    trainer otherwise (per-level psum'd partials). Returns (params, aux)."""
+    if cfg.max_depth == 1 and cfg.splitter == "exact":
+        return stump_trainer.fit(mesh, X, y, cfg)
+    return hist_trainer.fit(mesh, X, y, cfg)
+
+
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "make_mesh",
     "single_device_mesh",
     "distributed",
+    "fit_gbdt_sharded",
     "hist_trainer",
     "stump_trainer",
 ]

@@ -172,6 +172,42 @@ def load_model(path: str | os.PathLike) -> Any:
     return restore_params(path, _decode_template(sidecar["root"]))
 
 
+class StageCheckpointer:
+    """Stage-level checkpoint/resume for multi-stage fits (SURVEY.md §5
+    "Failure detection": the reference restarts from zero on any error; the
+    round-1 build could resume only the GBDT boosting loop). Each named
+    stage's output pytree is written via ``save_model`` (JSON sidecar last,
+    so the sidecar's existence marks the stage durable); on re-entry a
+    completed stage restores instead of recomputing. Stage outputs are
+    deterministic, so a resumed pipeline equals an unbroken one.
+    """
+
+    def __init__(
+        self, root: str | os.PathLike, _interrupt_after: str | None = None
+    ) -> None:
+        self.root = os.path.abspath(os.fspath(root))
+        os.makedirs(self.root, exist_ok=True)
+        self._interrupt_after = _interrupt_after  # test hook (preemption)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def completed(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self._path(name), _TEMPLATE_FILE))
+
+    def run(self, name: str, compute):
+        """Return the stage's output: restored if previously completed,
+        else ``compute()`` then checkpointed (durably, before the optional
+        simulated-preemption hook fires)."""
+        if self.completed(name):
+            return load_model(self._path(name))
+        out = compute()
+        save_model(self._path(name), out)
+        if self._interrupt_after == name:
+            raise SimulatedInterrupt(f"after stage {name!r}")
+        return out
+
+
 def boosting_manager(
     directory: str | os.PathLike, *, max_to_keep: int = 2
 ) -> ocp.CheckpointManager:

@@ -45,6 +45,37 @@ def stratified_kfold_test_masks_within(
     return masks
 
 
+def stratified_subsample_indices(
+    y: np.ndarray,
+    m: int,
+    rows: np.ndarray | None = None,
+    seed: int = 0,
+) -> np.ndarray:
+    """Deterministic stratified subsample of ``m`` indices (from ``rows``,
+    default all): per-class counts by largest-remainder apportionment of the
+    class frequencies, rows drawn without replacement by a seeded Generator.
+    Returns sorted indices into the full array — the scaled-regime guard's
+    sampling primitive (SURVEY.md §7 "SVC on TPU": subsample above the
+    kernel-matrix threshold)."""
+    y = np.asarray(y)
+    rows = np.arange(y.shape[0]) if rows is None else np.asarray(rows)
+    if m >= rows.shape[0]:
+        return np.sort(rows)
+    rng = np.random.default_rng(seed)
+    ysub = y[rows]
+    classes, counts = np.unique(ysub, return_counts=True)
+    quota = m * counts / counts.sum()
+    take = np.floor(quota).astype(int)
+    # largest remainders round up until the total hits m
+    for c in np.argsort(-(quota - take))[: m - take.sum()]:
+        take[c] += 1
+    picked = []
+    for c, t in zip(classes, take):
+        members = rows[ysub == c]
+        picked.append(rng.choice(members, size=t, replace=False))
+    return np.sort(np.concatenate(picked))
+
+
 def stratified_kfold_test_masks(y: np.ndarray, k: int) -> np.ndarray:
     """``StratifiedKFold(k, shuffle=False)`` exactly as sklearn assigns it:
     for each class, its occurrences (in row order) are dealt into folds in

@@ -113,6 +113,31 @@ def test_train_save_plots_predict_roundtrip(tmp_path, capsys):
     assert abs(float(m.group(1)) - 100 * prob) < 0.005
 
 
+def test_train_mesh_flag_routes_sharded(tmp_path, capsys):
+    """`train --mesh 4,2` fits the GBDT member through the row-sharded
+    trainers on the virtual CPU mesh and matches the meshless train's
+    reported AUC (sharded == single-device parity at the CLI level)."""
+    rc = cli.main([
+        "train",
+        "--synthetic", "160",
+        "--config", _fast_config(tmp_path),
+        "--mesh", "4,2",
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "mesh {'data': 4, 'model': 2}" in captured.err
+    m = re.search(r"AUC-ROC (\d+\.\d+)", captured.out)
+    assert m
+    auc_sharded = float(m.group(1))
+
+    rc = cli.main([
+        "train", "--synthetic", "160", "--config", _fast_config(tmp_path),
+    ])
+    assert rc == 0
+    m2 = re.search(r"AUC-ROC (\d+\.\d+)", capsys.readouterr().out)
+    assert abs(float(m2.group(1)) - auc_sharded) < 1e-6
+
+
 def test_sweep_cli(tmp_path, capsys):
     rc = cli.main([
         "sweep",
