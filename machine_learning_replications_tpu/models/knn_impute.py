@@ -39,15 +39,30 @@ class KNNImputerParams:
 
 
 def fit(
-    X_fit: jnp.ndarray, cfg: ImputerConfig = ImputerConfig(), seed: int = 2020
+    X_fit: jnp.ndarray,
+    cfg: ImputerConfig = ImputerConfig(),
+    seed: int = 2020,
+    y: np.ndarray | None = None,
 ) -> KNNImputerParams:
     X_np = np.asarray(X_fit)
     if X_np.shape[0] > cfg.max_donors:
-        keep = np.sort(
-            np.random.default_rng(seed).choice(
-                X_np.shape[0], size=cfg.max_donors, replace=False
+        if y is not None:
+            # Label-stratified cap: keeps the donor pool's outcome mix equal
+            # to the cohort's, so rare-class rows keep same-class donors at
+            # the same rate as the full 1-NN reference semantics (ADVICE r2).
+            from machine_learning_replications_tpu.utils.cv import (
+                stratified_subsample_indices,
             )
-        )
+
+            keep = stratified_subsample_indices(
+                np.asarray(y), cfg.max_donors, seed=seed
+            )
+        else:
+            keep = np.sort(
+                np.random.default_rng(seed).choice(
+                    X_np.shape[0], size=cfg.max_donors, replace=False
+                )
+            )
         donors = jnp.asarray(X_np[keep])
     else:
         donors = jnp.asarray(X_fit)
@@ -78,11 +93,28 @@ def _transform_block(params: KNNImputerParams, X: jnp.ndarray) -> jnp.ndarray:
 
 
 def transform(
-    params: KNNImputerParams, X: jnp.ndarray, chunk_rows: int | None = None
+    params: KNNImputerParams,
+    X: jnp.ndarray,
+    chunk_rows: int | None = None,
+    mesh=None,
 ) -> jnp.ndarray:
     """``_transform_block`` over query chunks; single block when the query
-    fits (``chunk_rows=None`` → ``ImputerConfig().chunk_rows``)."""
+    fits (``chunk_rows=None`` → ``ImputerConfig().chunk_rows``).
+
+    With ``mesh``, query rows are sharded over the 'data' axis — the
+    imputation of a row depends only on the (replicated) donor matrix, so
+    the transform is embarrassingly row-parallel (VERDICT r2 item 5: at 10M
+    rows this was the next single-device wall after the GBDT member)."""
     chunk = ImputerConfig().chunk_rows if chunk_rows is None else chunk_rows
+    if mesh is not None:
+        from machine_learning_replications_tpu.parallel.rowwise import (
+            apply_rows_sharded,
+        )
+
+        return apply_rows_sharded(
+            mesh, _transform_block, params, X,
+            chunk_rows=chunk, pad_value=np.nan,
+        )
     n = int(X.shape[0])
     if n <= chunk:
         return _transform_block(params, X)
@@ -100,7 +132,11 @@ def transform(
 
 
 def fit_transform(
-    X_fit: jnp.ndarray, cfg: ImputerConfig = ImputerConfig(), seed: int = 2020
+    X_fit: jnp.ndarray,
+    cfg: ImputerConfig = ImputerConfig(),
+    seed: int = 2020,
+    mesh=None,
+    y: np.ndarray | None = None,
 ) -> tuple[KNNImputerParams, jnp.ndarray]:
-    params = fit(X_fit, cfg, seed)
-    return params, transform(params, X_fit, cfg.chunk_rows)
+    params = fit(X_fit, cfg, seed, y=y)
+    return params, transform(params, X_fit, cfg.chunk_rows, mesh=mesh)

@@ -55,11 +55,29 @@ class PipelineParams:
     ensemble: stacking.StackingParams
 
 
+class _NullStages:
+    """Stage runner used when no checkpoint dir is given: straight through."""
+
+    def run(self, name: str, compute):
+        return compute()
+
+
+def _make_stages(checkpoint_dir, _interrupt_after):
+    if checkpoint_dir is None:
+        return _NullStages()
+    from machine_learning_replications_tpu.persist.orbax_io import (
+        StageCheckpointer,
+    )
+
+    return StageCheckpointer(checkpoint_dir, _interrupt_after=_interrupt_after)
+
+
 def fit_stacking(
     X: np.ndarray,
     y: np.ndarray,
     cfg: ExperimentConfig = ExperimentConfig(),
     mesh=None,
+    stages=None,
 ) -> stacking.StackingParams:
     """Fit the stacking ensemble on (already imputed + selected) ``X[n, 17]``.
 
@@ -74,44 +92,66 @@ def fit_stacking(
     the row-sharded trainers (``parallel.fit_gbdt_sharded`` — histogram
     partials psum over the 'data' axis); a 1-device mesh is the same code
     path (BASELINE config 5's contract).
+
+    ``stages`` (a ``StageCheckpointer`` or None) makes each member fit and
+    the meta pass a resumable checkpointed stage (SURVEY.md §5 failure
+    detection); stage outputs are deterministic, so a resumed fit equals an
+    unbroken one.
     """
+    if stages is None:
+        stages = _NullStages()
     Xj = jnp.asarray(X)
     yj = jnp.asarray(y)
 
     # --- full-data member fits (the predict-time estimators_) -------------
-    svc_rows = _svc_fit_rows(y, cfg, fold=None)
-    Xsvc = Xj if svc_rows is None else Xj[svc_rows]
-    ysvc = yj if svc_rows is None else yj[svc_rows]
-    scaler_p = scaler.fit(Xsvc)
-    svc_p = svm.svc_fit(
-        scaler.transform(scaler_p, Xsvc),
-        ysvc,
-        C=cfg.svc.C,
-        gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
-        balanced=cfg.svc.class_weight == "balanced",
-        probability=cfg.svc.probability,
-        platt_cv=cfg.svc.platt_cv,
-        tol=cfg.svc.tol,
-        max_iter=cfg.svc.max_iter,
-    )
-    if mesh is not None:
-        from machine_learning_replications_tpu.parallel import fit_gbdt_sharded
+    def _fit_svc():
+        svc_rows = _svc_fit_rows(y, cfg, fold=None)
+        Xsvc = Xj if svc_rows is None else Xj[svc_rows]
+        ysvc = yj if svc_rows is None else yj[svc_rows]
+        scaler_p = scaler.fit(Xsvc)
+        svc_p = svm.svc_fit(
+            scaler.transform(scaler_p, Xsvc),
+            ysvc,
+            C=cfg.svc.C,
+            gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
+            balanced=cfg.svc.class_weight == "balanced",
+            probability=cfg.svc.probability,
+            platt_cv=cfg.svc.platt_cv,
+            tol=cfg.svc.tol,
+            max_iter=cfg.svc.max_iter,
+        )
+        return scaler_p, svc_p
 
-        gbdt_p, _ = fit_gbdt_sharded(mesh, np.asarray(X), np.asarray(y), cfg.gbdt)
-    else:
-        gbdt_p, _ = gbdt.fit(np.asarray(X), np.asarray(y), cfg.gbdt)
-    lg_p = solvers.logreg_l1_fit(
-        Xj, yj, C=cfg.logreg.C, balanced=cfg.logreg.class_weight == "balanced",
-        tol=cfg.logreg.tol, max_iter=cfg.logreg.max_iter,
-    )
+    def _fit_gbdt():
+        if mesh is not None:
+            from machine_learning_replications_tpu.parallel import (
+                fit_gbdt_sharded,
+            )
+
+            return fit_gbdt_sharded(mesh, np.asarray(X), np.asarray(y), cfg.gbdt)[0]
+        return gbdt.fit(np.asarray(X), np.asarray(y), cfg.gbdt)[0]
+
+    def _fit_lg():
+        return solvers.logreg_l1_fit(
+            Xj, yj, C=cfg.logreg.C,
+            balanced=cfg.logreg.class_weight == "balanced",
+            tol=cfg.logreg.tol, max_iter=cfg.logreg.max_iter,
+        )
+
+    scaler_p, svc_p = stages.run("member_svc", _fit_svc)
+    gbdt_p = stages.run("member_gbdt", _fit_gbdt)
+    lg_p = stages.run("member_lg", _fit_lg)
 
     # --- cross_val_predict meta-features ----------------------------------
-    meta_X = cross_val_member_probas(X, y, cfg)
+    def _fit_meta():
+        meta_X = cross_val_member_probas(X, y, cfg, mesh=mesh)
+        meta_p = solvers.logreg_l2_fit(
+            jnp.asarray(meta_X), yj, C=cfg.meta.C,
+            tol=cfg.meta.tol, max_iter=cfg.meta.max_iter,
+        )
+        return jnp.asarray(meta_X), meta_p
 
-    meta_p = solvers.logreg_l2_fit(
-        jnp.asarray(meta_X), yj, C=cfg.meta.C,
-        tol=cfg.meta.tol, max_iter=cfg.meta.max_iter,
-    )
+    _, meta_p = stages.run("meta", _fit_meta)
 
     return stacking.StackingParams(
         scaler=scaler_p, svc=svc_p, gbdt=gbdt_p, logreg=lg_p, meta=meta_p
@@ -147,12 +187,18 @@ def _svc_fit_rows(
 
 
 def cross_val_member_probas(
-    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig
+    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig, mesh=None
 ) -> np.ndarray:
     """Out-of-fold P(class 1) per member — the ``[n, 3]`` meta-feature matrix
     (sklearn: ``cross_val_predict(est, X, y, cv=5, method='predict_proba')``
     per member, first column dropped) — all k folds of each member as one
     vmapped XLA program.
+
+    With ``mesh``, the GBDT fold fits (the member that scales with rows) run
+    sequentially through the row-sharded level-wise trainer instead of the
+    single-device vmap — same masked-fold semantics (weight-0 rows parked at
+    node −1), same bins (``bin_budget_capped``), so the meta-features match
+    the single-device path; all k folds share one compiled program.
 
     Fold membership is a ``[k, n]`` mask, never a row subset, so every fold
     shares one static shape (SURVEY.md §7 "fold-size padding with masked
@@ -214,8 +260,27 @@ def cross_val_member_probas(
         svc_oof = jnp.sum(p_svc * test_masks, axis=0)
 
     # --- GBDT: mask-parked fold fits, one program for all k folds ---------
-    gp = gbdt.fit_folds(X, y, train_masks_np, cfg.gbdt)
-    p_gbdt = jax.vmap(lambda p: tree.predict_proba1(p, Xj))(gp)  # [k, n]
+    if mesh is not None:
+        from machine_learning_replications_tpu.ops import binning
+        from machine_learning_replications_tpu.parallel import fit_gbdt_sharded
+
+        if X.shape[0] >= gbdt.DEVICE_BINNING_MIN_ROWS:
+            fold_bins = binning.bin_features_device(
+                X, gbdt.bin_budget_capped(cfg.gbdt)
+            )
+        else:
+            fold_bins = binning.bin_features(X, gbdt.bin_budget_capped(cfg.gbdt))
+        probas = []
+        for j in range(k):  # one compiled program, k reuses
+            gp_j, _ = fit_gbdt_sharded(
+                mesh, X, y, cfg.gbdt, bins=fold_bins,
+                sample_weight=train_masks_np[j],
+            )
+            probas.append(tree.predict_proba1(gp_j, Xj))
+        p_gbdt = jnp.stack(probas)  # [k, n]
+    else:
+        gp = gbdt.fit_folds(X, y, train_masks_np, cfg.gbdt)
+        p_gbdt = jax.vmap(lambda p: tree.predict_proba1(p, Xj))(gp)  # [k, n]
 
     # --- L1 logistic regression: masked FISTA --------------------------
     def one_fold_lg(tm):
@@ -354,19 +419,50 @@ def fit_pipeline(
     y: np.ndarray,
     cfg: ExperimentConfig = ExperimentConfig(),
     mesh=None,
+    checkpoint_dir: str | None = None,
+    _interrupt_after: str | None = None,
 ) -> tuple[PipelineParams, dict[str, Any]]:
     """The full reference program: impute → select → stack.
 
     ``X64`` is the raw 64-variable cohort (NaNs allowed); returns fitted
-    params plus selection diagnostics. ``mesh`` routes the GBDT member
-    through the sharded trainers (see ``fit_stacking``).
+    params plus selection diagnostics. ``mesh`` routes the row-parallel
+    stages (imputer transform, GBDT member + fold fits) through the mesh.
+
+    ``checkpoint_dir`` makes every stage resumable: impute → select →
+    member_svc → member_gbdt → member_lg → meta, each durably checkpointed
+    on completion (atomic sidecar publish), so a preempted run re-entered
+    with the same arguments restores finished stages instead of recomputing
+    (SURVEY.md §5 failure-detection row). ``_interrupt_after`` is the test
+    hook simulating preemption right after a named stage commits.
     """
-    imp_p, X_imp = knn_impute.fit_transform(
-        jnp.asarray(X64), cfg.imputer, cfg.seed
+    stages = _make_stages(checkpoint_dir, _interrupt_after)
+
+    imp_p, X_imp = stages.run(
+        "impute",
+        lambda: knn_impute.fit_transform(
+            jnp.asarray(X64), cfg.imputer, cfg.seed, mesh=mesh, y=y
+        ),
     )
     X_imp = np.asarray(X_imp)
-    mask, info = feature_selection.fit_select(X_imp, y, cfg.select)
-    ens = fit_stacking(X_imp[:, mask], y, cfg, mesh=mesh)
+
+    def _select():
+        mask, info = feature_selection.fit_select(X_imp, y, cfg.select)
+        # Flattened to a sidecar-encodable tuple (dicts aren't pytree
+        # checkpoint nodes); rebuilt below.
+        return (
+            jnp.asarray(mask), jnp.asarray(info["coef"]), info["intercept"],
+            info["alpha_"], jnp.asarray(info["alphas"]),
+            jnp.asarray(info["mse_path"]),
+        )
+
+    sel = stages.run("select", _select)
+    mask = np.asarray(sel[0])
+    info = {
+        "coef": np.asarray(sel[1]), "intercept": float(sel[2]),
+        "alpha_": float(sel[3]), "alphas": np.asarray(sel[4]),
+        "mse_path": np.asarray(sel[5]),
+    }
+    ens = fit_stacking(X_imp[:, mask], y, cfg, mesh=mesh, stages=stages)
     return (
         PipelineParams(
             imputer=imp_p, support_mask=jnp.asarray(mask), ensemble=ens
@@ -375,9 +471,24 @@ def fit_pipeline(
     )
 
 
-def pipeline_predict_proba1(params: PipelineParams, X64: np.ndarray) -> jnp.ndarray:
-    """Raw 64-feature rows (NaNs allowed) → stacked P(class 1)."""
-    X_imp = knn_impute.transform(params.imputer, jnp.asarray(X64))
+def pipeline_predict_proba1(
+    params: PipelineParams, X64: np.ndarray, mesh=None
+) -> jnp.ndarray:
+    """Raw 64-feature rows (NaNs allowed) → stacked P(class 1).
+
+    With ``mesh``, both the imputer transform and the stacked probability
+    pass run row-sharded over the 'data' axis (each is a pure per-row map
+    given replicated params), so batch prediction scales with the mesh the
+    same way training does (VERDICT r2 item 5)."""
+    X_imp = knn_impute.transform(params.imputer, jnp.asarray(X64), mesh=mesh)
     mask = np.asarray(params.support_mask)
     X17 = X_imp[:, np.where(mask)[0]]
+    if mesh is not None:
+        from machine_learning_replications_tpu.parallel.rowwise import (
+            apply_rows_sharded,
+        )
+
+        return apply_rows_sharded(
+            mesh, stacking.predict_proba1, params.ensemble, X17
+        )
     return stacking.predict_proba1(params.ensemble, X17)

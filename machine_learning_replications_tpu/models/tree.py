@@ -56,8 +56,26 @@ def apply_one_tree(
 
 
 def apply(params: TreeEnsembleParams, X: jnp.ndarray) -> jnp.ndarray:
-    """All trees on all rows → ``[T, n]`` leaf values (vmapped over trees)."""
+    """All trees on all rows → ``[T, n]`` leaf values (vmapped over trees).
+
+    Depth 1 (the reference's flagship shape) takes a specialized route: one
+    ``[n, T]`` gather of each stump's root-split column, a broadcast
+    compare, and a two-way select — no per-row node indices at all. The
+    generic unrolled descent costs a ``[T, n]`` row-gather per level, which
+    TPU serializes far more aggressively (measured 1.3 s vs ~ms for 100
+    stumps on 200k rows on v5e).
+    """
     X = jnp.asarray(X)
+    if params.max_depth == 1:
+        f0 = params.feature[:, 0]                  # [T] root split features
+        thr0 = params.threshold[:, 0]              # [T]
+        lchild = params.left[:, 0]                 # [T] (self-loop 0 if no split)
+        rchild = params.right[:, 0]
+        t_idx = jnp.arange(f0.shape[0])
+        lv = params.value[t_idx, lchild]           # [T] left-leaf values
+        rv = params.value[t_idx, rchild]           # [T]
+        Xg = X[:, f0]                              # [n, T] single gather
+        return jnp.where(Xg <= thr0[None, :], lv[None, :], rv[None, :]).T
     return jax.vmap(
         lambda f, t, l, r, v: apply_one_tree(f, t, l, r, v, X, params.max_depth)
     )(params.feature, params.threshold, params.left, params.right, params.value)

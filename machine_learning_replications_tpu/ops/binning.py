@@ -79,3 +79,39 @@ def bin_features(X: np.ndarray, n_bins: int | None = 256) -> BinnedFeatures:
         # the left bin — searchsorted(side='left') gives precisely that.
         binned[:, f] = np.searchsorted(mids, X[:, f], side="left")
     return BinnedFeatures(binned=binned, thresholds=thresholds, n_bins=counts)
+
+
+def bin_features_device(X, n_bins: int = 256) -> BinnedFeatures:
+    """Device-side quantile binning for the scaled regime.
+
+    ``bin_features`` runs ``np.unique`` per column — ~20 s of host time at
+    10M rows, dwarfing the sharded fit it feeds. This variant sorts each
+    column on device and takes ``n_bins`` *empirical* quantile candidates
+    (duplicates weighted, LightGBM-style) rather than unique-value
+    quantiles. Duplicate candidates yield duplicate midpoints — harmless:
+    the extra boundaries describe the same row partition, so split gains
+    tie and selection's first-index tie-break picks a boundary whose
+    threshold routes identically. The returned ``BinnedFeatures`` carries
+    device arrays; ``n_bins`` is reported as the candidate count (bin ids
+    still index midpoints the same way as the host build).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    Xj = jnp.asarray(X)
+    n, F = Xj.shape
+    Xs = jnp.sort(Xj, axis=0)                              # [n, F]
+    q_idx = jnp.round(
+        jnp.linspace(0.0, 1.0, n_bins) * (n - 1)
+    ).astype(jnp.int32)
+    u = Xs[q_idx, :]                                       # [B, F] candidates
+    mids = (u[:-1] + u[1:]) / 2.0
+    # sklearn BestSplitter guard: a midpoint that rounds up to the upper
+    # value would mis-route the upper sample under "x <= t goes left".
+    mids = jnp.where(mids == u[1:], u[:-1], mids)          # [B-1, F]
+    binned = jax.vmap(
+        lambda m, col: jnp.searchsorted(m, col, side="left"),
+        in_axes=(1, 1), out_axes=1,
+    )(mids, Xj).astype(jnp.int32)                          # [n, F]
+    counts = np.full(F, n_bins, np.int32)
+    return BinnedFeatures(binned=binned, thresholds=mids.T, n_bins=counts)

@@ -98,6 +98,47 @@ def build_stump_data(bins, y, dtype=None) -> StumpData:
     )
 
 
+def build_stump_data_device(bins, y, dtype=None) -> StumpData:
+    """``build_stump_data`` with the heavy work (argsort + layout gathers)
+    on device instead of host numpy.
+
+    The host build cost dominated the whole fit at bench scale (measured
+    ~5 s of a 5.8 s 200k-row fit on v5e; the device loop itself is ~0.1 s).
+    ``jnp.argsort(stable=True)`` matches ``np.argsort(kind='stable')``, so
+    the layout — and therefore the fitted forest — is identical to the host
+    build's. ``bins.binned``/``bins.thresholds`` may be numpy or device
+    arrays (the device-binning path passes device arrays straight through).
+    """
+    b = jnp.asarray(bins.binned)
+    n, F = b.shape
+    B = int(bins.max_bins)
+    bin_dtype = (
+        jnp.uint8 if B <= 256 else jnp.uint16 if B <= 65536 else jnp.int32
+    )
+    order = jnp.argsort(b, axis=0, stable=True)          # [n, F]
+    # bins_x[fq, fs, i] = b[order[i, fs], fq]: one gather + transpose.
+    bins_x = jnp.transpose(b[order.T, :], (2, 0, 1)).astype(bin_dtype)
+    y_sorted = jnp.take_along_axis(
+        jnp.broadcast_to(jnp.asarray(y)[None, :], (F, n)), order.T, axis=1
+    )
+    # left_count[f, b] = #rows with bin ≤ b — searchsorted on each sorted
+    # column (positions are static data, so this replaces host bincounts).
+    bins_sorted = jnp.take_along_axis(b, order, axis=0)  # [n, F] cols sorted
+    boundaries = jnp.arange(B - 1, dtype=b.dtype)
+    left_count = jax.vmap(
+        lambda col: jnp.searchsorted(col, boundaries, side="right")
+    )(bins_sorted.T).astype(jnp.int32)                   # [F, B-1]
+    thresholds = jnp.asarray(bins.thresholds)
+    ys = y_sorted
+    if dtype is not None:
+        thresholds = thresholds.astype(dtype)
+        ys = ys.astype(dtype)
+    return StumpData(
+        bins_x=bins_x, y_sorted=ys,
+        left_count=left_count, thresholds=thresholds,
+    )
+
+
 def cumulative_boundary_sums(
     v_sorted: jnp.ndarray, left_count: jnp.ndarray
 ) -> jnp.ndarray:

@@ -26,14 +26,28 @@ from machine_learning_replications_tpu.parallel import (
 )
 
 
-def fit_gbdt_sharded(mesh, X, y, cfg):
+def fit_gbdt_sharded(mesh, X, y, cfg, sample_weight=None, bins=None):
     """Mesh-sharded GBDT fit, dispatching like ``models.gbdt.fit``: the
-    replicated-sorted stump trainer at depth 1 (sklearn-exact splits, rows
-    over 'data', feature tiles over 'model'), the level-wise histogram
-    trainer otherwise (per-level psum'd partials). Returns (params, aux)."""
-    if cfg.max_depth == 1 and cfg.splitter == "exact":
-        return stump_trainer.fit(mesh, X, y, cfg)
-    return hist_trainer.fit(mesh, X, y, cfg)
+    replicated-sorted stump trainer at depth 1 (rows over 'data', feature
+    tiles over 'model' — dense per-stage math, no gathers), the level-wise
+    histogram trainer at depth ≥ 2 (per-level psum'd partials), or as the
+    depth-1 fallback when the sorted layout would blow the per-shard memory
+    budget. Returns (params, aux)."""
+    if cfg.max_depth == 1:
+        from machine_learning_replications_tpu.models import gbdt as _gbdt
+
+        if bins is None:
+            bins = _gbdt.default_bins(X, cfg)
+        n, F = bins.binned.shape
+        _, _, _, per_shard = stump_trainer._layout_plan(
+            n, F, int(bins.max_bins),
+            mesh.shape[DATA_AXIS], mesh.shape[MODEL_AXIS],
+        )
+        if per_shard <= stump_trainer.MAX_LAYOUT_BYTES:
+            return stump_trainer.fit(
+                mesh, X, y, cfg, bins=bins, sample_weight=sample_weight
+            )
+    return hist_trainer.fit(mesh, X, y, cfg, bins=bins, sample_weight=sample_weight)
 
 
 __all__ = [

@@ -1,0 +1,78 @@
+"""Row-sharded application of pure per-row functions.
+
+The cohort's row axis is the framework's universal parallel dimension
+(SURVEY.md §2.5 "Rows of the cohort … all fits/predicts"): imputation of a
+query block, batch prediction of any fitted member, and the stacked
+ensemble's probability pass are all embarrassingly row-parallel. This module
+is the one implementation of that pattern: pad the row axis to a multiple of
+the mesh's 'data' axis, ``device_put`` with ``NamedSharding(P('data', …))``,
+replicate the (small) parameter pytree, and let GSPMD partition the jitted
+computation — no collectives are needed because nothing crosses rows.
+
+Chunking bounds device memory for O(rows · donors/support) intermediates
+(the imputer's distance matrix, the SVC kernel block): each chunk shares one
+static shape, so the whole loop reuses a single compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from machine_learning_replications_tpu.parallel.mesh import DATA_AXIS
+
+
+def replicate(mesh: jax.sharding.Mesh, params: Any) -> Any:
+    """Copy a parameter pytree onto every device of ``mesh`` (fully
+    replicated sharding), so sharded-row computations can close over it
+    without device-mismatch errors."""
+    return jax.device_put(params, NamedSharding(mesh, P()))
+
+
+def apply_rows_sharded(
+    mesh: jax.sharding.Mesh,
+    fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    params: Any,
+    X: np.ndarray,
+    *,
+    chunk_rows: int | None = None,
+    pad_value: float = 0.0,
+) -> jnp.ndarray:
+    """``fn(params, X_block)`` with rows of ``X`` sharded over 'data'.
+
+    ``fn`` must be pure and row-wise (row i of the output depends only on
+    row i of ``X`` and on ``params``); its output's leading axis must match
+    the block's. Padding rows (``pad_value``) flow through ``fn`` and are
+    sliced off, so ``fn`` must tolerate them without poisoning real rows —
+    true for any row-wise map.
+
+    ``chunk_rows`` caps the rows per compiled call (rounded up to a multiple
+    of the data-axis size so every shard stays equal); None processes all
+    rows in one call.
+    """
+    X_np = np.asarray(X)
+    n = X_np.shape[0]
+    S = mesh.shape[DATA_AXIS]
+    chunk = n if chunk_rows is None else min(chunk_rows, n)
+    chunk = max(-(-chunk // S) * S, S)
+    spec = P(DATA_AXIS, *([None] * (X_np.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    params_r = replicate(mesh, params)
+    jfn = jax.jit(fn)
+
+    outs = []
+    for s in range(0, n, chunk):
+        block = X_np[s : s + chunk]
+        real = block.shape[0]
+        if real < chunk:  # tail: pad so every block shares one shape
+            pad = np.full((chunk - real,) + X_np.shape[1:], pad_value, X_np.dtype)
+            block = np.concatenate([block, pad])
+        out = jfn(params_r, jax.device_put(block, sharding))
+        if n <= chunk:  # single block: stay on device
+            return out[:real]
+        outs.append(np.asarray(out)[:real])
+    return jnp.asarray(np.concatenate(outs, axis=0))

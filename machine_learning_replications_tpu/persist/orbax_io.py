@@ -149,12 +149,27 @@ def save_model(path: str | os.PathLike, params: Any) -> None:
     by *name* (resolved against a fixed registry at load) plus shape/dtype
     per array leaf and plain values for static fields."""
     import json
+    import tempfile
 
     path = os.path.abspath(os.fspath(path))
     save_params(path, params)
     sidecar = {"format": 1, "root": _encode_template(params)}
-    with open(os.path.join(path, _TEMPLATE_FILE), "w") as f:
-        json.dump(sidecar, f, indent=1)
+    # Atomic publish: the sidecar's existence is the durability marker
+    # (StageCheckpointer.completed), so it must never exist half-written.
+    # Write to a temp file in the same directory, fsync, then os.replace.
+    fd, tmp = tempfile.mkstemp(
+        prefix=_TEMPLATE_FILE + ".", suffix=".tmp", dir=path
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(sidecar, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, _TEMPLATE_FILE))
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
 
 
 def load_model(path: str | os.PathLike) -> Any:
@@ -198,9 +213,18 @@ class StageCheckpointer:
     def run(self, name: str, compute):
         """Return the stage's output: restored if previously completed,
         else ``compute()`` then checkpointed (durably, before the optional
-        simulated-preemption hook fires)."""
+        simulated-preemption hook fires). ``save_model`` publishes the
+        sidecar atomically, so a present sidecar implies a complete one;
+        should a corrupt checkpoint nonetheless surface (e.g. torn tensorstore
+        files from a crash mid-``save_params``), the stage falls back to
+        recomputing rather than wedging the resume."""
         if self.completed(name):
-            return load_model(self._path(name))
+            try:
+                return load_model(self._path(name))
+            except Exception:
+                import shutil
+
+                shutil.rmtree(self._path(name), ignore_errors=True)
         out = compute()
         save_model(self._path(name), out)
         if self._interrupt_after == name:

@@ -7,6 +7,7 @@ round-trip exactly, and a resumable boosting loop whose post-preemption
 result is bit-identical to an unbroken fit.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -125,6 +126,78 @@ def test_resumable_equals_unbroken(tmp_path, fitted_forest):
         np.asarray(resumed.value), np.asarray(params.value)
     )
     np.testing.assert_array_equal(aux2["train_deviance"], aux["train_deviance"])
+
+
+def test_pipeline_stage_resume_equals_unbroken(tmp_path, cohort):
+    """Pipeline-level preemption-resume (VERDICT r2 missing #2): a fit
+    interrupted after the GBDT member stage, re-entered with the same
+    checkpoint dir, must restore the finished stages (impute → select →
+    svc → gbdt) instead of recomputing, and the final params must equal an
+    unbroken fit's bit for bit (stage outputs are deterministic)."""
+    from machine_learning_replications_tpu.config import (
+        ExperimentConfig, GBDTConfig, LassoSelectConfig, SVCConfig,
+    )
+    from machine_learning_replications_tpu.models import pipeline
+
+    X, y, _ = cohort
+    X = np.asarray(X[:220])
+    y = np.asarray(y[:220])
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=8),
+        svc=SVCConfig(platt_cv=2, max_iter=300),
+        select=LassoSelectConfig(cv_folds=3, n_alphas=20),
+    )
+    unbroken, _ = pipeline.fit_pipeline(X, y, cfg)
+
+    ckdir = str(tmp_path / "stages")
+    with pytest.raises(orbax_io.SimulatedInterrupt):
+        pipeline.fit_pipeline(
+            X, y, cfg, checkpoint_dir=ckdir, _interrupt_after="member_gbdt"
+        )
+    ck = orbax_io.StageCheckpointer(ckdir)
+    assert ck.completed("impute") and ck.completed("member_gbdt")
+    assert not ck.completed("meta")
+
+    # "New process": finished stages restore, the rest compute.
+    resumed, _ = pipeline.fit_pipeline(X, y, cfg, checkpoint_dir=ckdir)
+    assert ck.completed("meta")
+    for got, want in zip(
+        jax.tree.leaves(resumed), jax.tree.leaves(unbroken)
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # Re-entry after completion restores everything (no recompute, no drift).
+    again, _ = pipeline.fit_pipeline(X, y, cfg, checkpoint_dir=ckdir)
+    for got, want in zip(jax.tree.leaves(again), jax.tree.leaves(unbroken)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_stage_checkpointer_recovers_from_torn_sidecar(tmp_path):
+    """A truncated sidecar (crash mid-write before the atomic-replace fix,
+    or torn tensorstore files) must not wedge resume: the stage falls back
+    to recompute (ADVICE r2 medium)."""
+    import os
+
+    calls = {"n": 0}
+
+    def compute():
+        calls["n"] += 1
+        return (np.arange(4.0), np.ones(3))
+
+    ck = orbax_io.StageCheckpointer(str(tmp_path / "s"))
+    out1 = ck.run("stage_a", compute)
+    assert calls["n"] == 1
+    # Corrupt the sidecar in place — simulates a pre-fix torn write.
+    sidecar = os.path.join(str(tmp_path / "s"), "stage_a", "pytree_template.json")
+    with open(sidecar, "w") as f:
+        f.write('{"format": 1, "root": {"seq": [')
+    out2 = ck.run("stage_a", compute)
+    assert calls["n"] == 2  # recomputed, not crashed
+    np.testing.assert_array_equal(np.asarray(out2[0]), np.asarray(out1[0]))
+    # ...and the re-written checkpoint is whole again.
+    out3 = ck.run("stage_a", compute)
+    assert calls["n"] == 2
+    np.testing.assert_array_equal(np.asarray(out3[0]), np.asarray(out1[0]))
 
 
 def test_resumable_deeper_path(tmp_path, cohort_full):
