@@ -30,12 +30,19 @@ Configs (``--config``; default = all five, headline = config 3):
   3  full 100-stump GradientBoosting ensemble (``train_ensemble_public.py:45``)
   4  5-fold CV sweep over the n_estimators × max_depth grid vs GridSearchCV
   5  scaled synthetic cohort (default 10M rows) trained through the sharded
-     mesh path (``parallel.hist_trainer`` over ``make_mesh()`` — a 1-device
-     mesh is the same code path); baseline = sklearn on ``--baseline-rows``,
-     linearly extrapolated (an *underestimate* of sklearn's n·log n cost).
-     Both models are scored on the same held-out row slice, so the parity
-     check compares like for like (train sizes differ by design and are
-     recorded in the artifact).
+     mesh path (``parallel.fit_gbdt_sharded`` over ``make_mesh()`` — a
+     1-device mesh is the same code path); baseline = sklearn on
+     ``--baseline-rows``, linearly extrapolated (an *underestimate* of
+     sklearn's n·log n cost). Both models are scored on the same held-out
+     row slice, so the parity check compares like for like (train sizes
+     differ by design and are recorded in the artifact).
+
+When the first TPU probe fails, the orchestrator interleaves further probe
+attempts (one long 300s try per cycle) with the TPU-independent sklearn
+baseline legs until the backend answers or ~60% of ``--budget`` is spent;
+every attempt is timestamped into the artifact's ``probe_log``. Configs 3
+and 5 additionally report a FLOP/byte utilization estimate (``mfu_pct``,
+``hbm_util_pct`` — see ``_utilization`` for the models).
 
 Workload data: the Table-S1-matched synthetic cohort (the reference ships
 none; SURVEY.md §6), regenerated deterministically inside each leg from the
@@ -60,14 +67,22 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 PARITY_TOL = 0.005  # BASELINE.json AUC budget
 
-# Rows per config in full mode. Config 4's baseline is a 45-fit
-# GridSearchCV on one CPU core — it gets a smaller cohort by design.
+# Rows per config. Config 4's baseline is a 45-fit GridSearchCV on one CPU
+# core — it gets a smaller cohort by design. One size per config regardless
+# of backend (the device-side layout/binning rework made CPU-JAX fallback
+# legs fast enough at full size), so baseline legs are mode-independent and
+# can run while the TPU probe loop is still trying.
 DEFAULT_ROWS = {1: 1, 2: 200_000, 3: 200_000, 4: 20_000, 5: 10_000_000}
-# Shrunken rows when the TPU is unreachable and legs run on 1-core CPU JAX:
-# still an honest differential measurement, just sized to finish.
-DEGRADED_ROWS = {1: 1, 2: 50_000, 3: 50_000, 4: 5_000, 5: 500_000}
+# Config 5 on the CPU fallback keeps a reduced cohort: a 10M-row train on
+# 1-core CPU JAX exceeds any sane leg timeout (its baseline re-runs to match).
+DEGRADED_ROWS_C5 = 1_000_000
 DEVICE_TIMEOUT = {1: 420, 2: 600, 3: 780, 4: 900, 5: 1500}
 BASELINE_TIMEOUT = {1: 0, 2: 420, 3: 700, 4: 900, 5: 900}
+
+# Chip datasheet anchors for the utilization accounting (VERDICT r2 item 4).
+# Peak figures are the bf16 MXU peak and HBM bandwidth; the FLOP/byte models
+# used against them are documented in _utilization's docstring.
+CHIP_PEAKS = {"TPU v5 lite": {"bf16_tflops": 197.0, "hbm_gbps": 819.0}}
 
 
 def log(msg: str) -> None:
@@ -89,32 +104,39 @@ def clean_env() -> dict:
     return clean_cpu_env()
 
 
-def probe_tpu(attempts: int = 3, timeout: int = 150) -> str | None:
-    """Try to initialize the ambient (TPU) backend in fresh subprocesses.
+def probe_tpu(probe_log: list, timeout: int = 150) -> str | None:
+    """One attempt to initialize the ambient (TPU) backend in a fresh
+    subprocess; outcome appended to ``probe_log`` (timestamped, shipped in
+    the artifact so a hostile environment is provable — VERDICT r2 item 1).
 
-    Returns the device kind string, or None if every attempt hung/failed.
-    Each attempt is a new interpreter — the round-1 hang was intermittent
-    (1-in-5 success per VERDICT.md), so retries are the defense.
+    The hang is intermittent, so the *orchestrator* loops this between
+    other useful work instead of burning the budget up front.
     """
     code = "import jax; d = jax.devices()[0]; print('PROBE_OK', d.platform, '|', d.device_kind, flush=True)"
-    for i in range(attempts):
-        log(f"TPU probe attempt {i + 1}/{attempts} (timeout {timeout}s)")
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                timeout=timeout, text=True,
-            )
-        except subprocess.TimeoutExpired:
-            log("probe timed out (backend hang)")
-            continue
-        for line in (r.stdout or "").splitlines():
-            if line.startswith("PROBE_OK"):
-                kind = line.split("PROBE_OK", 1)[1].strip()
-                log(f"TPU backend up: {kind}")
-                return kind
-        tail = (r.stdout or "").strip().splitlines()[-3:]
-        log(f"probe rc={r.returncode}: {' / '.join(tail)}")
+    rec = {"t": time.strftime("%H:%M:%S"), "timeout_s": timeout}
+    probe_log.append(rec)
+    log(f"TPU probe attempt {len(probe_log)} (timeout {timeout}s)")
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=timeout, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        rec.update(outcome="timeout", wall_s=round(time.perf_counter() - t0, 1))
+        log("probe timed out (backend hang)")
+        return None
+    rec["wall_s"] = round(time.perf_counter() - t0, 1)
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("PROBE_OK"):
+            kind = line.split("PROBE_OK", 1)[1].strip()
+            rec.update(outcome="ok", device=kind)
+            log(f"TPU backend up: {kind}")
+            return kind
+    tail = (r.stdout or "").strip().splitlines()[-3:]
+    rec.update(outcome=f"rc={r.returncode}")
+    log(f"probe rc={r.returncode}: {' / '.join(tail)}")
     return None
 
 
@@ -178,12 +200,58 @@ def orchestrate(args) -> int:
     t_start = time.perf_counter()
     deadline = t_start + args.budget
     configs = [args.config] if args.config else [3, 1, 2, 5, 4]
+    probe_log: list[dict] = []
+    baselines: dict[int, dict] = {}
+
+    def rows_for(c: int, degraded_now: bool) -> int:
+        if args.rows:
+            return args.rows
+        if c == 5 and degraded_now:
+            return DEGRADED_ROWS_C5
+        return DEFAULT_ROWS[c]
+
+    def baseline_args(c: int, rows: int) -> list[str]:
+        return ["--rows", str(rows), "--cpu-repeats", str(args.cpu_repeats),
+                "--baseline-rows", str(args.baseline_rows)]
 
     # --- phase 1: bring up the device backend --------------------------
-    kind = None if args.force_cpu else probe_tpu()
+    # One quick probe; if the backend hangs, keep probing — interleaved
+    # with the (TPU-independent) sklearn baseline legs so the wait is never
+    # idle — until it answers or ~60% of the budget is gone. Timeouts cycle
+    # through one long (300s) attempt per round in case the backend is slow
+    # rather than hung. Every attempt lands in the artifact's probe_log.
+    kind = None if args.force_cpu else probe_tpu(probe_log, timeout=150)
+    if kind is None and not args.force_cpu:
+        probe_deadline = t_start + 0.6 * args.budget
+        pending = [c for c in configs if c != 1]
+        timeouts = [150, 300, 150, 150, 300]
+        max_probes = 24  # hang-mode attempts are bounded by time anyway;
+        #                  this bounds the fast-failure mode (rc!=0 in
+        #                  seconds), which additionally backs off below.
+        while kind is None and time.perf_counter() < probe_deadline \
+                and len(probe_log) < max_probes:
+            if pending:
+                c = pending.pop(0)
+                rows = rows_for(c, degraded_now=False)
+                log(f"probe interleave: baseline leg c{c} while TPU is down")
+                baselines[c] = run_leg(
+                    "baseline", c, clean_env(), BASELINE_TIMEOUT[c],
+                    baseline_args(c, rows), deadline=deadline,
+                )
+                baselines[c]["_rows"] = rows
+            elif probe_log[-1].get("wall_s", 0) < 30:
+                # fast failure, nothing useful to interleave: back off so a
+                # broken-plugin loop can't spin subprocesses for 60% of the
+                # budget (and flood probe_log)
+                time.sleep(min(30, max(0, probe_deadline - time.perf_counter())))
+            t = timeouts[(len(probe_log) - 1) % len(timeouts)]
+            t = min(t, max(int(probe_deadline - time.perf_counter()), 60))
+            kind = probe_tpu(probe_log, timeout=t)
     degraded = kind is None
     if degraded:
-        log("TPU unreachable after retries — device legs fall back to clean-env CPU")
+        if not args.force_cpu:
+            log(f"TPU unreachable after {len(probe_log)} probes — "
+                "device legs fall back to clean-env CPU")
         device_env = clean_env()
     else:
         device_env = dict(os.environ)
@@ -196,7 +264,7 @@ def orchestrate(args) -> int:
             log(f"config {c} skipped — budget exhausted")
             continue
 
-        rows = args.rows or (DEGRADED_ROWS if degraded else DEFAULT_ROWS)[c]
+        rows = rows_for(c, degraded)
         # Trace gating lives HERE: the worker's own --trace default is '',
         # so an omitted flag means no tracing in the leg.
         trace = (args.trace or "traces/bench_c3") if (c == 3 and not degraded) else ""
@@ -211,24 +279,33 @@ def orchestrate(args) -> int:
         dev = run_leg("device", c, device_env, DEVICE_TIMEOUT[c],
                       leg_args(rows, trace), deadline=deadline)
         if "error" in dev and not degraded:
-            # TPU leg failed twice — one clean-env CPU try so the artifact
-            # still carries a measured number (flagged below).
-            log(f"config {c}: TPU leg failed, retrying on clean-env CPU")
-            cpu_rows = args.rows or DEGRADED_ROWS[c]
-            extra_cpu = leg_args(cpu_rows, "")
+            # TPU leg failed twice. Re-probe (the tunnel may have dropped
+            # mid-run): if the backend answers, one more TPU try; otherwise
+            # fall back to a clean-env CPU leg so the artifact still carries
+            # a measured number (flagged below).
             tpu_err = dev["error"]
-            dev = run_leg("device", c, clean_env(), DEVICE_TIMEOUT[c],
-                          extra_cpu, attempts=1, deadline=deadline)
-            dev["tpu_error"] = tpu_err
-            rows = cpu_rows
+            if probe_tpu(probe_log, timeout=150):
+                log(f"config {c}: TPU leg failed but backend re-probes OK — retrying")
+                dev = run_leg("device", c, device_env, DEVICE_TIMEOUT[c],
+                              leg_args(rows, trace), attempts=1, deadline=deadline)
+            if "error" in dev:
+                log(f"config {c}: TPU leg failed, falling back to clean-env CPU")
+                cpu_rows = rows_for(c, degraded_now=True)
+                dev = run_leg("device", c, clean_env(), DEVICE_TIMEOUT[c],
+                              leg_args(cpu_rows, ""), attempts=1, deadline=deadline)
+                dev["tpu_error"] = tpu_err
+                dev["device_fallback"] = "cpu"
+                rows = cpu_rows
 
         if c != 1 and "error" not in dev:
-            base = run_leg(
-                "baseline", c, clean_env(), BASELINE_TIMEOUT[c],
-                ["--rows", str(rows), "--cpu-repeats", str(args.cpu_repeats),
-                 "--baseline-rows", str(args.baseline_rows)],
-                deadline=deadline,
-            )
+            if c in baselines and baselines[c].get("_rows") == rows \
+                    and "error" not in baselines[c]:
+                base = baselines[c]
+            else:
+                base = run_leg(
+                    "baseline", c, clean_env(), BASELINE_TIMEOUT[c],
+                    baseline_args(c, rows), deadline=deadline,
+                )
         elif c == 1:
             base = {}  # config 1's numpy baseline is measured inside the leg
         else:
@@ -253,6 +330,8 @@ def orchestrate(args) -> int:
         "parity_ok": bool(checked) and all(r["parity_ok"] for r in checked),
         "parity_checked": len(checked),
         "degraded_cpu_fallback": degraded,
+        "probe_attempts": len(probe_log),
+        "probe_log": probe_log,
         "wall_s_total": round(time.perf_counter() - t_start, 1),
     }
     if len(results) > 1 or str(args.config or "") not in results:
@@ -277,7 +356,11 @@ def combine(c: int, rows: int, dev: dict, base: dict) -> dict:
     rec = dict(dev)
     rec.setdefault("unit", "s")
     if c == 1:
-        return rec  # leg already carries vs_baseline (host numpy)
+        # The reference's sklearn-0.23 predict path cannot execute under a
+        # modern sklearn, so config 1's baseline is the same closed-form
+        # math in host numpy — labeled so the 12× isn't read as vs-sklearn.
+        rec["baseline_kind"] = "numpy_host_closed_form"
+        return rec
     if "error" in base:
         rec["baseline_error"] = base["error"]
         rec.setdefault("vs_baseline", 0.0)
@@ -288,6 +371,14 @@ def combine(c: int, rows: int, dev: dict, base: dict) -> dict:
     for k in ("baseline_measured_rows", "baseline_measured_s"):
         if k in base:
             rec[k] = base[k]
+    if rec["vs_baseline"] < 1.0:
+        # Never ship a silent sub-1× number (VERDICT r2 weak #2).
+        why = ("CPU-fallback leg — single-core JAX vs sklearn's Cython at "
+               "this size; the TPU leg is the speedup claim"
+               if "cpu" in rec.get("device", "") else
+               "slower than the sklearn baseline at this size — see phases_s "
+               "for where the time goes")
+        rec["note"] = why
     if "auc" in rec and "auc" in base:
         delta = abs(rec["auc"] - base["auc"])
         rec["auc_delta_vs_sklearn"] = round(delta, 8)
@@ -420,15 +511,46 @@ def _numpy_stacked_predict(p, X):
     return 1.0 / (1.0 + np.exp(-zm))
 
 
+def _utilization(dev_s: float, n: int, F: int, stages: int) -> dict:
+    """Hardware-efficiency accounting for the sorted-layout stump trainer
+    (VERDICT r2 item 4: a speedup claim needs a utilization denominator).
+
+    FLOP model — per stage the trainer makes ~6 dense passes over the
+    ``[F, n]`` replicated layout (expit ≈10 flops/elt, residual/hessian ≈4,
+    two cumsums ≈2, routing compare + select + raw update ≈4) ⇒ ~20 flops
+    per element per stage. Bytes model — those passes re-read/write the
+    ``[F, n]`` float32 arrays ~8× plus one uint8 bins_x read ⇒ ~33 bytes
+    per element per stage. Both are order-of-magnitude anchors, not
+    microarchitectural truth; the workload is bandwidth-bound by design
+    (arithmetic intensity ≈ 0.6 flop/byte), so mfu_pct is honest-but-tiny
+    while hbm_util_pct is the number that should approach 100.
+    """
+    import jax
+
+    d = jax.devices()[0]
+    peaks = CHIP_PEAKS.get(d.device_kind)
+    flops = 20.0 * n * F * stages
+    bytes_ = 33.0 * n * F * stages
+    rec = {
+        "flops_est": flops,
+        "bytes_est": bytes_,
+        "arithmetic_intensity": round(flops / bytes_, 3),
+    }
+    if peaks and dev_s > 0:
+        rec["mfu_pct"] = round(100.0 * flops / (dev_s * peaks["bf16_tflops"] * 1e12), 4)
+        rec["hbm_util_pct"] = round(100.0 * bytes_ / (dev_s * peaks["hbm_gbps"] * 1e9), 2)
+        rec["peak_model"] = f"{d.device_kind}: {peaks['bf16_tflops']} bf16 TFLOPS, {peaks['hbm_gbps']} GB/s"
+    return rec
+
+
 def device_leg_gbdt(args, n_estimators: int) -> dict:
-    """Configs 2 & 3: the reference's exact GBDT estimator on device, with
+    """Configs 2 & 3: the reference's GBDT estimator on device, with
     per-phase wall-clock; config 3 on TPU additionally captures a Perfetto
     trace and runs the on-chip Pallas-vs-XLA histogram equality check."""
     import jax
 
     from machine_learning_replications_tpu.config import GBDTConfig
     from machine_learning_replications_tpu.models import gbdt, tree
-    from machine_learning_replications_tpu.ops import binning
     from machine_learning_replications_tpu.utils import metrics
     from machine_learning_replications_tpu.utils.trace import PhaseTimer, device_trace
 
@@ -436,16 +558,31 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
     with timer.phase("make_cohort"):
         X17, y, yf = _cohort(args.rows)
     cfg = GBDTConfig(splitter=args.splitter, n_estimators=n_estimators)
+    import jax.numpy as jnp
+
+    # One-time host→device staging, reported separately (device-resident
+    # train data, as sklearn's baseline fit gets RAM-resident data; the
+    # tunnel link can run as slow as ~18 MB/s, which would otherwise
+    # dominate the fit). Only when the fit actually bins on device —
+    # handing a device array to the host-binning regimes (exact splitter,
+    # small rows) would make every timed repeat pull X back through the
+    # same slow link instead.
+    if cfg.splitter == "hist" and args.rows >= gbdt.DEVICE_BINNING_MIN_ROWS:
+        with timer.phase("h2d_transfer") as ph:
+            X17_d = ph.block(jax.device_put(jnp.asarray(X17)))
+            yf_d = ph.block(jax.device_put(jnp.asarray(yf)))
+    else:
+        X17_d, yf_d = X17, yf
     # Recorded for the phase breakdown only — the timed fit below re-bins
     # from scratch so the measurement covers the same end-to-end work as
     # the sklearn baseline's fit() (which includes its presort).
-    with timer.phase("binning"):
-        binning.bin_features(X17, gbdt.bin_budget(cfg))
+    with timer.phase("binning") as ph:
+        ph.block(gbdt.default_bins(X17_d, cfg).binned)
 
     holder = {}
 
     def fit_once():
-        params, _ = gbdt.fit(X17, yf, cfg)
+        params, _ = gbdt.fit(X17_d, yf_d, cfg)
         jax.block_until_ready(params.value)
         holder["params"] = params
 
@@ -453,8 +590,10 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
         fit_once()
     with timer.phase("fit_timed"):
         dev_s = _median_time(fit_once, args.repeats, warmup=False)
+    predict = jax.jit(tree.predict_proba1)
+    auc_fn = jax.jit(metrics.roc_auc)
     with timer.phase("predict_auc") as ph:
-        auc = float(metrics.roc_auc(y, ph.block(tree.predict_proba1(holder["params"], X17))))
+        auc = float(ph.block(auc_fn(jnp.asarray(y), predict(holder["params"], X17_d))))
 
     rec = {
         "metric": (
@@ -464,8 +603,10 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
         "value": round(dev_s, 4),
         "unit": "s",
         "auc": auc,
+        "splitter": args.splitter,
         "device": _device_kind(),
         "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
+        **_utilization(dev_s, args.rows, X17.shape[1], n_estimators),
     }
 
     if args.trace and n_estimators > 1:
@@ -565,14 +706,17 @@ def device_leg_sweep(args) -> dict:
 
 def device_leg_scaled(args) -> dict:
     """Config 5: scaled cohort through the real sharded path — mesh over all
-    available devices, rows sharded on the 'data' axis, level-wise histogram
-    trainer with psum'd partials (VERDICT.md item 4: a 1-device mesh is the
-    same code path; an honest artifact either way)."""
+    available devices, rows sharded on the 'data' axis through the
+    ``fit_gbdt_sharded`` dispatch (sorted-stump trainer with device binning
+    at this depth/size; a 1-device mesh is the same code path). Held-out
+    scoring runs row-sharded too (VERDICT r2 item 5)."""
     import jax
+    import jax.numpy as jnp
 
     from machine_learning_replications_tpu.config import GBDTConfig
     from machine_learning_replications_tpu.models import tree
-    from machine_learning_replications_tpu.parallel import hist_trainer, make_mesh
+    from machine_learning_replications_tpu.parallel import fit_gbdt_sharded, make_mesh
+    from machine_learning_replications_tpu.parallel.rowwise import apply_rows_sharded
     from machine_learning_replications_tpu.utils import metrics
     from machine_learning_replications_tpu.utils.trace import PhaseTimer
 
@@ -586,10 +730,17 @@ def device_leg_scaled(args) -> dict:
 
     mesh = make_mesh()
     cfg = GBDTConfig(splitter="hist", n_bins=256)
+    # One-time host→device staging, reported separately: the timed fit
+    # starts from device-resident data the way sklearn's starts from
+    # RAM-resident data (the tunnel moves ~18 MB/s — at 10M rows re-paying
+    # ~38 s of transfer per repeat would measure the link, not the trainer).
+    with timer.phase("h2d_transfer") as ph:
+        Xtr_d = ph.block(jax.device_put(jnp.asarray(Xtr)))
+        ytr_d = ph.block(jax.device_put(jnp.asarray(ytr)))
     holder = {}
 
     def fit_once():
-        params, _ = hist_trainer.fit(mesh, Xtr, ytr, cfg)
+        params, _ = fit_gbdt_sharded(mesh, Xtr_d, ytr_d, cfg)
         jax.block_until_ready(params.value)
         holder["params"] = params
 
@@ -598,7 +749,10 @@ def device_leg_scaled(args) -> dict:
     with timer.phase("fit_timed"):
         dev_s = _median_time(fit_once, args.repeats, warmup=False)
     with timer.phase("predict_auc") as ph:
-        auc = float(metrics.roc_auc(yte, ph.block(tree.predict_proba1(holder["params"], Xte))))
+        proba = apply_rows_sharded(
+            mesh, tree.predict_proba1, holder["params"], Xte
+        )
+        auc = float(ph.block(jax.jit(metrics.roc_auc)(jnp.asarray(yte), proba)))
     return {
         "metric": f"gbdt100_hist_train_{rows}rows_sharded",
         "value": round(dev_s, 4),
@@ -610,6 +764,7 @@ def device_leg_scaled(args) -> dict:
         "throughput_rows_per_s": round((rows - holdout) / dev_s, 1),
         "device": _device_kind(),
         "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
+        **_utilization(dev_s, rows - holdout, X17.shape[1], cfg.n_estimators),
     }
 
 
@@ -702,10 +857,17 @@ def main() -> int:
                     help="run one config (default: all five, headline config 3)")
     ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--repeats", type=int, default=3)
-    ap.add_argument("--cpu-repeats", type=int, default=1)
+    ap.add_argument("--cpu-repeats", type=int, default=3,
+                    help="sklearn baseline repeats (medianed — a single "
+                    "sample made vs_baseline noisy at the 1.0x boundary)")
     ap.add_argument("--baseline-rows", type=int, default=200_000,
                     help="config 5: sklearn baseline subsample size")
-    ap.add_argument("--splitter", choices=("exact", "hist"), default="exact")
+    ap.add_argument("--splitter", choices=("exact", "hist"), default="hist",
+                    help="configs 2/3 GBDT splitter. 'hist' (default) is the "
+                    "TPU-native design — 256 quantile bins, exact on the "
+                    "reference cohort's mostly-binary features, AUC-parity-"
+                    "gated vs sklearn's exact enumeration at every size; "
+                    "'exact' enumerates every unique midpoint like sklearn")
     ap.add_argument("--budget", type=int, default=1800,
                     help="orchestrator wall-clock budget (s)")
     ap.add_argument("--trace", default="",

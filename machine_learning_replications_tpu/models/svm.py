@@ -178,40 +178,66 @@ def _project_box_hyperplane(v, s, C, iters: int = 64):
     return jnp.clip(v - lam * s, 0.0, C)
 
 
+_KKT_CHECK_EVERY = 8  # optimality matvec every k iterations (~12% overhead)
+
+
 def solve_dual(K, s, C, tol: float = 1e-5, max_iter: int = 3000):
     """Accelerated projected-gradient ascent on the SVC dual.
 
     Returns α. ``C`` is per-sample (class weights × C × fold mask).
-    Stops when the iterate change drops below ``tol · (1 + ‖α‖∞)`` —
-    ``SVCConfig.tol``/``max_iter`` thread here (the round-1 build ran a
-    fixed 3000 iterations regardless, VERDICT.md weak #6) — and composes
-    with ``vmap`` (the Platt CV lanes run until all converge).
+    Stops on libsvm's own optimality measure — the maximal KKT violation
+    ``m(α) − M(α)`` over the working sets (``svm.cpp select_working_set``) —
+    evaluated every ``_KKT_CHECK_EVERY`` iterations, so ``SVCConfig.tol``
+    means exactly what sklearn's ``SVC(tol=...)`` means rather than a
+    looser iterate-change proxy (ADVICE r2). Composes with ``vmap`` (the
+    Platt CV lanes run until all converge).
     """
     from machine_learning_replications_tpu.models.solvers import _power_lmax
 
     Q = (s[:, None] * s[None, :]) * K
     step = 1.0 / jnp.maximum(_power_lmax(Q), 1e-12)
+    inf = jnp.asarray(jnp.inf, s.dtype)
+
+    def kkt_violation(a):
+        # libsvm minimizes f(α) = ½αᵀQα − 1ᵀα over {0≤α≤C, sᵀα=0};
+        # v_i = −s_i ∇f_i; stop when max_{I_up} v − min_{I_low} v ≤ tol.
+        v = -s * (Q @ a - 1.0)
+        active = C > 0  # fold-masked rows are frozen at α=0, outside both sets
+        up = (((s > 0) & (a < C)) | ((s < 0) & (a > 0))) & active
+        low = (((s > 0) & (a > 0)) | ((s < 0) & (a < C))) & active
+        m = jnp.max(jnp.where(up, v, -inf))
+        M = jnp.min(jnp.where(low, v, inf))
+        return m - M
 
     def cond(state):
-        _, _, _, it, delta = state
-        return (it < max_iter) & (delta >= tol)
+        _, _, _, it, viol = state
+        return (it < max_iter) & (viol >= tol)
 
-    def body(state):
-        a, z, tk, it, _ = state
+    def fista_step(_, carry):
+        a, z, tk = carry
         grad = 1.0 - Q @ z
         a_new = _project_box_hyperplane(z + step * grad, s, C)
         t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
         z = a_new + ((tk - 1.0) / t_new) * (a_new - a)
         # keep the extrapolated point feasible enough: re-clip the box
         z = jnp.clip(z, 0.0, C)
-        delta = jnp.max(jnp.abs(a_new - a)) / (1.0 + jnp.max(jnp.abs(a_new)))
-        return a_new, z, t_new, it + 1, delta
+        return a_new, z, t_new
+
+    def body(state):
+        # A fixed 8-step inner block followed by ONE optimality matvec —
+        # rather than a lax.cond on the iteration count, which under vmap
+        # (the Platt-CV / fold-fit lanes) lowers to a both-branches select
+        # and would pay the KKT matvec every iteration.
+        a, z, tk, it, _ = state
+        a, z, tk = jax.lax.fori_loop(
+            0, _KKT_CHECK_EVERY, fista_step, (a, z, tk)
+        )
+        return a, z, tk, it + _KKT_CHECK_EVERY, kkt_violation(a)
 
     a0 = jnp.zeros_like(s)
     a, _, _, _, _ = jax.lax.while_loop(
         cond, body,
-        (a0, a0, jnp.asarray(1.0, s.dtype), jnp.asarray(0, jnp.int32),
-         jnp.asarray(jnp.inf, s.dtype)),
+        (a0, a0, jnp.asarray(1.0, s.dtype), jnp.asarray(0, jnp.int32), inf),
     )
     return a
 

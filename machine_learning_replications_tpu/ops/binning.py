@@ -100,6 +100,10 @@ def bin_features_device(X, n_bins: int = 256) -> BinnedFeatures:
 
     Xj = jnp.asarray(X)
     n, F = Xj.shape
+    # Same contract as the host path: binning NaNs silently distorts the
+    # candidate set (they sort last), so refuse — impute first.
+    if bool(jnp.isnan(Xj).any()):
+        raise ValueError("input contains NaN; impute before binning")
     Xs = jnp.sort(Xj, axis=0)                              # [n, F]
     q_idx = jnp.round(
         jnp.linspace(0.0, 1.0, n_bins) * (n - 1)

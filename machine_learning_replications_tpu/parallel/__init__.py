@@ -33,11 +33,11 @@ def fit_gbdt_sharded(mesh, X, y, cfg, sample_weight=None, bins=None):
     histogram trainer at depth ≥ 2 (per-level psum'd partials), or as the
     depth-1 fallback when the sorted layout would blow the per-shard memory
     budget. Returns (params, aux)."""
-    if cfg.max_depth == 1:
-        from machine_learning_replications_tpu.models import gbdt as _gbdt
+    from machine_learning_replications_tpu.models import gbdt as _gbdt
 
-        if bins is None:
-            bins = _gbdt.default_bins(X, cfg)
+    if bins is None:
+        bins = _gbdt.default_bins(X, cfg)
+    if cfg.max_depth == 1:
         n, F = bins.binned.shape
         _, _, _, per_shard = stump_trainer._layout_plan(
             n, F, int(bins.max_bins),

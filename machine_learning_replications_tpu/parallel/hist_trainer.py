@@ -60,16 +60,17 @@ def fit(
     n_pad = -(-n // n_data) * n_data
     fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
 
-    binned = np.concatenate(
-        [np.asarray(bins.binned, np.int32),
-         np.zeros((n_pad - n, bins.binned.shape[1]), np.int32)]
+    # Padding on device: bins.binned may already live there (device binning
+    # in the scaled regime) — jnp.pad avoids a device→host→device bounce.
+    binned = jnp.pad(
+        jnp.asarray(bins.binned).astype(jnp.int32), ((0, n_pad - n), (0, 0))
     )
     w_real = (
-        np.ones(n, fdt) if sample_weight is None
-        else np.asarray(sample_weight, fdt)
+        jnp.ones(n, fdt) if sample_weight is None
+        else jnp.asarray(sample_weight).astype(fdt)
     )
-    w = np.concatenate([w_real, np.zeros(n_pad - n, fdt)])
-    yp = np.concatenate([np.asarray(y, fdt), np.zeros(n_pad - n, fdt)])
+    w = jnp.pad(w_real, (0, n_pad - n))
+    yp = jnp.pad(jnp.asarray(y).astype(fdt), (0, n_pad - n))
 
     def put(a, spec):
         return jax.device_put(a, NamedSharding(mesh, spec))
@@ -79,7 +80,7 @@ def fit(
         put(binned, P(DATA_AXIS, None)),
         put(w, P(DATA_AXIS)),
         put(yp, P(DATA_AXIS)),
-        put(np.asarray(bins.thresholds, fdt), P()),
+        put(jnp.asarray(bins.thresholds).astype(fdt), P()),
         n_stages=cfg.n_estimators,
         depth=cfg.max_depth,
         max_bins=bins.max_bins,
@@ -88,13 +89,9 @@ def fit(
         min_samples_leaf=cfg.min_samples_leaf,
         backend=gbdt.resolve_backend(cfg),
     )
-    # Weighted prior: must match the device-side f0 (= weighted log-odds),
-    # else a masked fold fit's stored init_raw would disagree with the raw
-    # scores its leaf values were fitted against.
-    p1 = float((w_real * np.asarray(y, fdt)).sum() / w_real.sum())
     params = gbdt.forest_to_params(
         feats, thrs, vals, splits,
-        init_raw=float(np.log(p1 / (1.0 - p1))),
+        init_raw=gbdt._prior_log_odds(y, sample_weight),
         learning_rate=cfg.learning_rate,
         max_depth=cfg.max_depth,
     )

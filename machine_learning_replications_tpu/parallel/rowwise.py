@@ -16,6 +16,7 @@ static shape, so the whole loop reuses a single compiled program.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -24,6 +25,25 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from machine_learning_replications_tpu.parallel.mesh import DATA_AXIS
+
+
+# One jitted wrapper per fn object, so repeated apply_rows_sharded calls
+# (batch prediction in a loop, chunked transforms) reuse the compiled
+# program instead of re-tracing each call. Bounded LRU rather than weak
+# keys: a jit wrapper strongly references its fn, so weak-key entries
+# could never be collected — the LRU instead evicts old wrappers (and
+# whatever their closures captured) once fresh-lambda callers exceed the
+# cap.
+@functools.lru_cache(maxsize=32)
+def _jitted_cached(fn: Callable) -> Callable:
+    return jax.jit(fn)
+
+
+def _jitted(fn: Callable) -> Callable:
+    try:
+        return _jitted_cached(fn)
+    except TypeError:  # unhashable callable
+        return jax.jit(fn)
 
 
 def replicate(mesh: jax.sharding.Mesh, params: Any) -> Any:
@@ -62,7 +82,7 @@ def apply_rows_sharded(
     spec = P(DATA_AXIS, *([None] * (X_np.ndim - 1)))
     sharding = NamedSharding(mesh, spec)
     params_r = replicate(mesh, params)
-    jfn = jax.jit(fn)
+    jfn = _jitted(fn)
 
     outs = []
     for s in range(0, n, chunk):

@@ -170,18 +170,12 @@ def fit(
     feats = np.asarray(feats)
     # padded feature slots can never be selected; map back is identity on [0, F)
     assert feats.max() < F
-    if sample_weight is None:
-        init_raw = gbdt._prior_log_odds(y)
-    else:  # weighted prior — must match the device-side f0
-        w = np.asarray(sample_weight, np.float64)
-        p1 = float((w * np.asarray(y, np.float64)).sum() / w.sum())
-        init_raw = np.asarray(np.log(p1 / (1.0 - p1)))
     params = gbdt.forest_to_params(
         jnp.asarray(feats),
         jnp.asarray(thrs),
         jnp.asarray(vals),
         jnp.asarray(splits),
-        init_raw=init_raw,
+        init_raw=gbdt._prior_log_odds(y, sample_weight),
         learning_rate=cfg.learning_rate,
         max_depth=1,
     )

@@ -198,11 +198,59 @@ class StageCheckpointer:
     """
 
     def __init__(
-        self, root: str | os.PathLike, _interrupt_after: str | None = None
+        self,
+        root: str | os.PathLike,
+        _interrupt_after: str | None = None,
+        fingerprint: str | None = None,
     ) -> None:
         self.root = os.path.abspath(os.fspath(root))
         os.makedirs(self.root, exist_ok=True)
         self._interrupt_after = _interrupt_after  # test hook (preemption)
+        if fingerprint is not None:
+            self._check_fingerprint(fingerprint)
+
+    def _check_fingerprint(self, fingerprint: str) -> None:
+        """Stage checkpoints are only valid for the inputs that produced
+        them; re-entering a directory with different (X, y, cfg) must fail
+        loudly instead of silently restoring a stale model."""
+        import json
+        import tempfile
+
+        fp_path = os.path.join(self.root, "fingerprint.json")
+        stored = None
+        if os.path.exists(fp_path):
+            try:
+                with open(fp_path) as f:
+                    stored = json.load(f)["fingerprint"]
+            except (OSError, json.JSONDecodeError, KeyError):
+                stored = None  # torn write — resolved below
+        if stored is not None:
+            if stored != fingerprint:
+                raise RuntimeError(
+                    f"checkpoint dir {self.root!r} was written by a fit with "
+                    f"different inputs (stored fingerprint {stored[:16]}…, "
+                    f"this fit {fingerprint[:16]}…); pass a fresh "
+                    "checkpoint_dir or delete the stale one"
+                )
+            return
+        # No (readable) fingerprint: if the dir already holds completed
+        # stages, they are of unknown provenance — adopting this run's
+        # fingerprint would silently restore them. Refuse instead.
+        stray = [
+            d for d in sorted(os.listdir(self.root))
+            if os.path.exists(os.path.join(self.root, d, _TEMPLATE_FILE))
+        ]
+        if stray:
+            raise RuntimeError(
+                f"checkpoint dir {self.root!r} holds completed stages "
+                f"({', '.join(stray)}) but no fingerprint recording which "
+                "inputs produced them; pass a fresh checkpoint_dir or delete "
+                "the stale one"
+            )
+        fd, tmp = tempfile.mkstemp(prefix="fingerprint.", dir=self.root)
+        with os.fdopen(fd, "w") as f:
+            json.dump({"fingerprint": fingerprint}, f)
+        os.replace(tmp, fp_path)
 
     def _path(self, name: str) -> str:
         return os.path.join(self.root, name)

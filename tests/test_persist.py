@@ -172,6 +172,29 @@ def test_pipeline_stage_resume_equals_unbroken(tmp_path, cohort):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_stage_checkpoint_dir_rejects_different_inputs(tmp_path, cohort):
+    """Re-entering a stage-checkpoint dir with different (X, y, cfg) must
+    fail loudly, not silently restore the other fit's stages."""
+    from machine_learning_replications_tpu.config import (
+        ExperimentConfig, GBDTConfig, LassoSelectConfig, SVCConfig,
+    )
+    from machine_learning_replications_tpu.models import pipeline
+
+    X, y, _ = cohort
+    X, y = np.asarray(X[:150]), np.asarray(y[:150])
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=4),
+        svc=SVCConfig(platt_cv=2, max_iter=150),
+        select=LassoSelectConfig(cv_folds=3, n_alphas=10),
+    )
+    ckdir = str(tmp_path / "fp")
+    pipeline.fit_pipeline(X, y, cfg, checkpoint_dir=ckdir)
+    with pytest.raises(RuntimeError, match="fingerprint"):
+        pipeline.fit_pipeline(X[:120], y[:120], cfg, checkpoint_dir=ckdir)
+    # same inputs still restore fine
+    pipeline.fit_pipeline(X, y, cfg, checkpoint_dir=ckdir)
+
+
 def test_stage_checkpointer_recovers_from_torn_sidecar(tmp_path):
     """A truncated sidecar (crash mid-write before the atomic-replace fix,
     or torn tensorstore files) must not wedge resume: the stage falls back
