@@ -37,10 +37,12 @@ class GBDTConfig:
     min_samples_split: int = 2
     min_samples_leaf: int = 1
     # Histogram-statistics backend for the level-wise (depth ≥ 2) tree
-    # grower: 'pallas' = the MXU one-hot-contraction kernel
-    # (ops.pallas_histogram; measured on-chip at 1.9× the XLA scatter-add —
+    # grower: 'matmul' = per-feature one-hot MXU contractions
+    # (ops.histogram.node_histograms_matmul — vmap-composable, exploits
+    # per-feature bin widths), 'pallas' = the VMEM-accumulating kernel
+    # (ops.pallas_histogram; measured on-chip at ~2× the XLA scatter-add —
     # v5e, 200k rows, K=8; see the bench artifact's pallas_onchip block),
-    # 'xla' = segment_sum, 'auto' = pallas on TPU / xla elsewhere.
+    # 'xla' = segment_sum, 'auto' = matmul on TPU / xla elsewhere.
     histogram_backend: str = "auto"
 
 

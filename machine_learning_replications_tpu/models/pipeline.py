@@ -521,27 +521,29 @@ def pipeline_predict_proba1(
     [rows, n_support] RBF kernel block, which at cohort scale must not be
     built for every row at once (default: ``SVCConfig.predict_chunk_rows``).
     """
+    from machine_learning_replications_tpu.config import SVCConfig
+
+    if chunk_rows is None:
+        chunk_rows = SVCConfig().predict_chunk_rows
     X_imp = knn_impute.transform(params.imputer, jnp.asarray(X64), mesh=mesh)
     mask = np.asarray(params.support_mask)
     X17 = X_imp[:, np.where(mask)[0]]
     if mesh is not None:
-        from machine_learning_replications_tpu.config import SVCConfig
         from machine_learning_replications_tpu.parallel.rowwise import (
             apply_rows_sharded,
         )
 
-        if chunk_rows is None:
-            chunk_rows = SVCConfig().predict_chunk_rows
         return apply_rows_sharded(
             mesh, stacking.predict_proba1, params.ensemble, X17,
             chunk_rows=chunk_rows,
         )
     n = int(X17.shape[0])
-    if chunk_rows is not None and n > chunk_rows:
-        # single-device chunking honors the same memory bound
+    if n > chunk_rows:
+        # single-device chunking honors the same memory bound; blocks stay
+        # as device arrays until the final concatenate
         blocks = [
-            np.asarray(stacking.predict_proba1(params.ensemble, X17[s : s + chunk_rows]))
+            stacking.predict_proba1(params.ensemble, X17[s : s + chunk_rows])
             for s in range(0, n, chunk_rows)
         ]
-        return jnp.asarray(np.concatenate(blocks))
+        return jnp.concatenate(blocks)
     return stacking.predict_proba1(params.ensemble, X17)

@@ -198,6 +198,86 @@ def node_histograms(
     )
 
 
+def node_histograms_matmul(
+    binned: jnp.ndarray,      # [n, F] int32
+    node_local: jnp.ndarray,  # [n] int32 — local node index, −1 ⇒ inactive row
+    grad: jnp.ndarray,        # [n]
+    hess: jnp.ndarray,        # [n]
+    n_nodes: int,
+    max_bins: int,
+    chunk: int = 4096,
+    feature_bins: tuple[int, ...] | None = None,
+) -> NodeHistograms:
+    """Histogram statistics as one-hot MXU contractions (no scatters).
+
+    TPU lowers ``segment_sum`` to serialized scatter-adds (measured 170 ms
+    at 200k rows × 17 features × K=8 on v5e); here each row-chunk builds a
+    per-feature ``[c, K·B_f]`` one-hot of its (node, bin) cell and
+    contracts ``[4, c] × [c, K·B_f]`` on the systolic array, accumulating
+    partials over a ``lax.scan`` of row chunks. Unlike the Pallas kernel
+    this is plain jnp, so it composes with ``vmap`` — the fold-fan-out
+    paths (``gbdt.fit_folds``, the CV sweep) use it on TPU.
+
+    ``feature_bins`` (static per-feature bin counts, ``bins.n_bins``) is
+    the big lever: the cost is the one-hot's HBM traffic, n·K·Σ_f B_f
+    floats, and on the HF cohort (14 of 17 features binary) Σ_f B_f is
+    ~8× smaller than F·max_bins — measured 75 ms → ~10 ms at 200k rows.
+    Without it every feature pays ``max_bins``.
+
+    f32 throughout (dots forced to HIGHEST: the TPU's default f32 matmul
+    rounds operands to bf16, which truncated gradient sums by ~1e-1 at
+    200k rows — far beyond tie-break noise). Only f32 accumulation order
+    differs vs ``segment_sum``: near-tied split gains may resolve
+    differently (the documented model-level parity contract).
+    """
+    n, F = binned.shape
+    dtype = grad.dtype
+    widths = tuple(feature_bins) if feature_bins is not None else (max_bins,) * F
+    assert len(widths) == F
+    n_pad = -(-n // chunk) * chunk
+    valid = (node_local >= 0).astype(dtype)
+    stats = jnp.stack(
+        [grad * valid, hess * valid, grad * grad * valid, valid], axis=0
+    )  # [4, n] — inactive/padding rows contribute nothing
+    stats = jnp.pad(stats, ((0, 0), (0, n_pad - n)))
+    node0 = jnp.pad(jnp.maximum(node_local, 0), (0, n_pad - n))
+    binned_p = jnp.pad(binned, ((0, n_pad - n), (0, 0)))
+
+    def body(accs, args):
+        stats_c, node_c, bins_c = args  # [4, c], [c], [c, F]
+        parts = []
+        for f in range(F):
+            bf = widths[f]
+            cell_f = node_c * bf + bins_c[:, f]  # [c] ∈ [0, K·B_f)
+            onehot_f = (
+                cell_f[:, None] == jnp.arange(n_nodes * bf, dtype=cell_f.dtype)
+            ).astype(dtype)
+            parts.append(
+                jax.lax.dot(
+                    stats_c, onehot_f, precision=jax.lax.Precision.HIGHEST
+                )
+            )  # [4, K·B_f]
+        return tuple(a + p for a, p in zip(accs, parts)), None
+
+    acc0 = tuple(jnp.zeros((4, n_nodes * bf), dtype) for bf in widths)
+    accs, _ = jax.lax.scan(
+        body,
+        acc0,
+        (
+            stats.reshape(4, n_pad // chunk, chunk).transpose(1, 0, 2),
+            node0.reshape(n_pad // chunk, chunk),
+            binned_p.reshape(n_pad // chunk, chunk, F),
+        ),
+    )
+    # Assemble [4, K, F, max_bins] (zero-padded past each feature's B_f).
+    cols = [
+        jnp.pad(a.reshape(4, n_nodes, bf), ((0, 0), (0, 0), (0, max_bins - bf)))
+        for a, bf in zip(accs, widths)
+    ]
+    out = jnp.stack(cols, axis=2)  # [4, K, F, B]
+    return NodeHistograms(grad=out[0], hess=out[1], grad2=out[2], count=out[3])
+
+
 def select_splits(
     GL: jnp.ndarray,          # [K, F, B-1] left-of-boundary residual sums
     CL: jnp.ndarray,          # [K, F, B-1] left-of-boundary counts

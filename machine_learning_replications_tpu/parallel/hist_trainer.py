@@ -88,6 +88,7 @@ def fit(
         min_samples_split=cfg.min_samples_split,
         min_samples_leaf=cfg.min_samples_leaf,
         backend=gbdt.resolve_backend(cfg),
+        feature_bins=gbdt._feature_bins(bins),
     )
     params = gbdt.forest_to_params(
         feats, thrs, vals, splits,
@@ -102,7 +103,7 @@ def fit(
     jax.jit,
     static_argnames=(
         "mesh", "n_stages", "depth", "max_bins", "learning_rate",
-        "min_samples_split", "min_samples_leaf", "backend",
+        "min_samples_split", "min_samples_leaf", "backend", "feature_bins",
     ),
 )
 def _fit_sharded(
@@ -119,6 +120,7 @@ def _fit_sharded(
     min_samples_split: int,
     min_samples_leaf: int,
     backend: str,
+    feature_bins: tuple[int, ...] | None = None,
 ):
     from jax import shard_map
 
@@ -142,7 +144,7 @@ def _fit_sharded(
             depth=depth, max_bins=max_bins,
             min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
-            hist_fn=gbdt.resolve_hist_fn(backend),
+            hist_fn=gbdt.resolve_hist_fn(backend, feature_bins),
             node_init=jnp.where(wl > 0, 0, -1).astype(jnp.int32),
             reduce_fn=lambda a: jax.lax.psum(a, DATA_AXIS),
         )
