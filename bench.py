@@ -223,11 +223,14 @@ def orchestrate(args) -> int:
     kind = None if args.force_cpu else probe_tpu(probe_log, timeout=150)
     if kind is None and not args.force_cpu:
         probe_deadline = t_start + 0.6 * args.budget
-        # Config 1 measures its baseline in-leg; config 5's baseline rows
-        # depend on whether we end up degraded (10M vs 1M), so interleaving
-        # it while the mode is unknown would burn up to 900s on a record
-        # the degraded path can never reuse.
-        pending = [c for c in configs if c not in (1, 5)]
+        # Config 1 measures its baseline in-leg. Config 5 interleaves LAST:
+        # its baseline rows depend on the outcome (10M if the TPU recovers,
+        # 1M degraded), so its interleaved record is reusable only in the
+        # recovered case — still worth doing with otherwise-idle probe
+        # time, but after the outcome-independent configs.
+        pending = [c for c in configs if c not in (1, 5)] + (
+            [5] if 5 in configs else []
+        )
         timeouts = [150, 300, 150, 150, 300]
         max_probes = 24  # hang-mode attempts are bounded by time anyway;
         #                  this bounds the fast-failure mode (rc!=0 in
@@ -625,10 +628,12 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
             rec["trace_error"] = f"{type(e).__name__}: {e}"
 
     if _is_tpu() and n_estimators > 1:
-        try:
-            rec["pallas_onchip"] = pallas_onchip_check(X17, yf)
-        except Exception as e:
-            rec["pallas_onchip"] = {"error": f"{type(e).__name__}: {e}"}
+        for attempt in (1, 2):  # remote-compile service flakes transiently
+            try:
+                rec["pallas_onchip"] = pallas_onchip_check(X17, yf)
+                break
+            except Exception as e:
+                rec["pallas_onchip"] = {"error": f"{type(e).__name__}: {e}"}
     return rec
 
 

@@ -65,7 +65,11 @@ class SVCConfig:
     platt_cv: int = 5
     tol: float = 1e-3
     max_iter: int = 20_000
-    max_rows: int = 20_000
+    # 8192² kernel + dual matrices ≈ 0.5 GB f32 — the 20k default measured
+    # as a worker-killing ~3.2 GB+ on the single v5e; the SVC member also
+    # carries the smallest meta weight (SURVEY §2.3: 0.41 of 5.13), so the
+    # subsample cap costs the least of the three members.
+    max_rows: int = 8_192
     scale_policy: str = "subsample"  # 'subsample' | 'error'
     predict_chunk_rows: int = 65_536  # bound the [chunk, n_sv] kernel at predict
 

@@ -88,7 +88,7 @@ def fit(
             min_samples_split=cfg.min_samples_split,
             min_samples_leaf=cfg.min_samples_leaf,
             backend=resolve_backend(cfg),
-            feature_bins=_feature_bins(bins),
+            feature_bins=binning.feature_bin_counts(bins),
         )
     params = forest_to_params(
         feature, threshold, value, is_split,
@@ -96,11 +96,6 @@ def fit(
         max_depth=cfg.max_depth,
     )
     return params, {"train_deviance": np.asarray(deviance)}
-
-
-def _feature_bins(bins: binning.BinnedFeatures) -> tuple[int, ...]:
-    """Static per-feature bin counts (the matmul backend's traffic lever)."""
-    return tuple(int(x) for x in np.asarray(bins.n_bins))
 
 
 def bin_budget(cfg: GBDTConfig) -> int | None:
@@ -192,7 +187,7 @@ def fit_resumable(
                 min_samples_split=cfg.min_samples_split,
                 min_samples_leaf=cfg.min_samples_leaf,
                 backend=resolve_backend(cfg),
-                feature_bins=_feature_bins(bins),
+                feature_bins=binning.feature_bin_counts(bins),
             )
 
     with orbax_io.boosting_manager(checkpoint_dir) as mgr:
@@ -434,10 +429,13 @@ def fit_folds(
         learning_rate=cfg.learning_rate,
         min_samples_split=cfg.min_samples_split,
         min_samples_leaf=cfg.min_samples_leaf,
-        # Both compose with vmap (the Pallas kernel has no batching rule);
-        # the MXU matmul contraction wins on TPU, scatter-adds on CPU.
-        backend="matmul" if jax.default_backend() == "tpu" else "xla",
-        feature_bins=_feature_bins(bins),
+        # Honor an explicit cfg backend; only 'pallas' must be remapped
+        # here (no vmap batching rule) — 'auto' then picks the MXU matmul
+        # contraction on TPU, scatter-adds on CPU.
+        backend=(
+            "matmul" if jax.default_backend() == "tpu" else "xla"
+        ) if resolve_backend(cfg) == "pallas" else resolve_backend(cfg),
+        feature_bins=binning.feature_bin_counts(bins),
     )
     M, NN = feature.shape[1], feature.shape[2]
     idx = jnp.arange(NN, dtype=jnp.int32)[None, None, :]

@@ -62,6 +62,27 @@ class _NullStages:
         return compute()
 
 
+# Memory budget for running SVC fold fits as vmapped lanes: each lane
+# materializes its own [m, m] kernel AND dual matrix, so k lanes cost
+# ~2·k·m²·itemsize at once (a 20k-row cap × 5 folds measured as a ~16 GB
+# on-chip OOM). At the shipped SVCConfig.max_rows=8192 and k=5, f32, the
+# lanes total ~2.7 GB — still above this budget, so the scaled regime
+# deliberately takes the sequential lax.map branch (one lane's ~0.5 GB at
+# a time); the vmapped branch serves the small-n regime (reference-cohort
+# sizes), where lane fan-out is the latency win.
+_SVC_VMAP_BYTES_BUDGET = 2 << 30
+
+
+def _svc_fold_map(one_fold, args: tuple, m: int, k: int, itemsize: int):
+    """vmap when all k lanes' kernel/dual matrices fit the budget, else a
+    sequential lax.map — identical math either way."""
+    import jax
+
+    if 2 * k * m * m * itemsize <= _SVC_VMAP_BYTES_BUDGET:
+        return jax.vmap(one_fold)(*args)
+    return jax.lax.map(lambda a: one_fold(*a), args)
+
+
 def _fit_fingerprint(X64, y, cfg) -> str:
     """Cheap input digest binding a stage-checkpoint dir to (X, y, cfg):
     shapes/dtypes, the config JSON, and a deterministic row sample of X/y
@@ -279,7 +300,10 @@ def cross_val_member_probas(
             )
             return svm.predict_proba1(vp, Xt)
 
-        p_svc = jax.vmap(one_fold_svc)(train_masks, platt_masks)  # [k, n]
+        p_svc = _svc_fold_map(
+            one_fold_svc, (train_masks, platt_masks),
+            m=n, k=k, itemsize=Xj.dtype.itemsize,
+        )  # [k, n]
         svc_oof = jnp.sum(p_svc * test_masks, axis=0)
 
     # --- GBDT: mask-parked fold fits, one program for all k folds ---------
@@ -387,7 +411,10 @@ def _svc_oof_subsampled(
         )
         return sp, vp
 
-    sps, vps = jax.vmap(one_fold)(Xsub, ysub, full, platt)
+    sps, vps = _svc_fold_map(
+        one_fold, (Xsub, ysub, full, platt),
+        m=m, k=k, itemsize=Xsub.dtype.itemsize,
+    )
 
     oof = np.zeros(y.shape[0])
     for j in range(k):  # host loop: k is 5; the chunked predict dominates

@@ -81,6 +81,12 @@ def bin_features(X: np.ndarray, n_bins: int | None = 256) -> BinnedFeatures:
     return BinnedFeatures(binned=binned, thresholds=thresholds, n_bins=counts)
 
 
+def feature_bin_counts(bins: BinnedFeatures) -> tuple[int, ...]:
+    """Static per-feature bin counts — the matmul histogram backend's
+    traffic lever (it sizes each feature's one-hot to its real bin range)."""
+    return tuple(int(x) for x in np.asarray(bins.n_bins))
+
+
 def bin_features_device(X, n_bins: int = 256) -> BinnedFeatures:
     """Device-side quantile binning for the scaled regime.
 

@@ -88,7 +88,7 @@ def fit(
         min_samples_split=cfg.min_samples_split,
         min_samples_leaf=cfg.min_samples_leaf,
         backend=gbdt.resolve_backend(cfg),
-        feature_bins=gbdt._feature_bins(bins),
+        feature_bins=binning.feature_bin_counts(bins),
     )
     params = gbdt.forest_to_params(
         feats, thrs, vals, splits,
