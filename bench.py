@@ -634,6 +634,8 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
                 break
             except Exception as e:
                 rec["pallas_onchip"] = {"error": f"{type(e).__name__}: {e}"}
+                if attempt == 1:  # keep the first flake diagnosable
+                    rec["pallas_onchip_first_error"] = f"{type(e).__name__}: {e}"
     return rec
 
 

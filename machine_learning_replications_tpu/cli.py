@@ -103,10 +103,12 @@ def cmd_train(args) -> int:
     X_dev, y_dev = _load_cohort(args, "develop")
     X_sel, y_sel = _load_cohort(args, "select")
 
-    params, info = pipeline.fit_pipeline(X_dev, y_dev, cfg, mesh=mesh)
+    params, info = pipeline.fit_pipeline(
+        X_dev, y_dev, cfg, mesh=mesh, checkpoint_dir=args.resume_dir
+    )
     print(f"selected {info['n_selected']} features", file=sys.stderr)
 
-    p1 = np.asarray(pipeline.pipeline_predict_proba1(params, X_sel))
+    p1 = np.asarray(pipeline.pipeline_predict_proba1(params, X_sel, mesh=mesh))
     yy = (p1 > 0.5).astype(np.float64)  # train_ensemble_public.py:63
     rep = metrics.classification_report(jnp.asarray(y_sel), jnp.asarray(yy))
     print(metrics.report_text(rep))
@@ -286,6 +288,13 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--distributed", action="store_true",
         help="bring up jax.distributed (multi-host) before building the mesh",
+    )
+    t.add_argument(
+        "--resume-dir", default=None,
+        help="stage-checkpoint directory: each pipeline stage (impute → "
+        "select → members → meta) is durably checkpointed so a preempted "
+        "run re-entered with the same data/config resumes instead of "
+        "restarting (the dir is fingerprinted against its inputs)",
     )
     t.set_defaults(fn=cmd_train)
 

@@ -133,6 +133,16 @@ def resolve_backend(cfg: GBDTConfig) -> str:
     )
 
 
+def resolve_backend_vmap_safe(cfg: GBDTConfig) -> str:
+    """``resolve_backend`` for paths that run under ``vmap`` (fold
+    fan-outs): honors an explicit 'xla'/'matmul' choice, remapping only
+    'pallas' — which has no batching rule — to the platform's 'auto' pick."""
+    b = resolve_backend(cfg)
+    if b != "pallas":
+        return b
+    return "matmul" if jax.default_backend() == "tpu" else "xla"
+
+
 def fit_resumable(
     X: np.ndarray,
     y: np.ndarray,
@@ -219,22 +229,27 @@ def fit_resumable(
 
 
 def _prior_log_odds(
-    y: np.ndarray, sample_weight: np.ndarray | None = None
-) -> np.ndarray:
-    """F₀ = log-odds of the (weighted) class prior — the single host-side
-    source of the boosting init score. The sharded trainers' device-side f0
-    must agree with this (their psum'd weighted means compute the same
-    quantity); keeping one copy here is what keeps them in lockstep."""
+    y, sample_weight=None
+) -> "np.ndarray | jax.Array":
+    """F₀ = log-odds of the (weighted) class prior — the single source of
+    the boosting init score. The sharded trainers' in-loop f0 must agree
+    with this (their psum'd weighted means compute the same quantity);
+    keeping one copy here is what keeps them in lockstep. Host inputs
+    return a numpy scalar; device-resident inputs return a device scalar
+    (no synchronous pull through the host link mid-fit)."""
     if isinstance(y, jax.Array) or isinstance(sample_weight, jax.Array):
-        # device-resident labels: reduce on device, move one scalar — not
-        # the whole vector back through a (possibly slow) host link
+        # device-resident labels: reduce on device and RETURN a device
+        # scalar — a float() here would be a synchronous round-trip through
+        # the (possibly slow) host link in the middle of an otherwise
+        # fully-async fit
         yj = jnp.asarray(y)
         if sample_weight is None:
-            p1 = float(jnp.mean(yj))
+            p1 = jnp.mean(yj)
         else:
             wj = jnp.asarray(sample_weight)
-            p1 = float(jnp.sum(wj * yj) / jnp.sum(wj))
-    elif sample_weight is None:
+            p1 = jnp.sum(wj * yj) / jnp.sum(wj)
+        return jnp.log(p1 / (1.0 - p1))
+    if sample_weight is None:
         p1 = float(np.mean(y))
     else:
         w = np.asarray(sample_weight, np.float64)
@@ -429,12 +444,7 @@ def fit_folds(
         learning_rate=cfg.learning_rate,
         min_samples_split=cfg.min_samples_split,
         min_samples_leaf=cfg.min_samples_leaf,
-        # Honor an explicit cfg backend; only 'pallas' must be remapped
-        # here (no vmap batching rule) — 'auto' then picks the MXU matmul
-        # contraction on TPU, scatter-adds on CPU.
-        backend=(
-            "matmul" if jax.default_backend() == "tpu" else "xla"
-        ) if resolve_backend(cfg) == "pallas" else resolve_backend(cfg),
+        backend=resolve_backend_vmap_safe(cfg),
         feature_bins=binning.feature_bin_counts(bins),
     )
     M, NN = feature.shape[1], feature.shape[2]

@@ -43,6 +43,36 @@ def _sklearn_reference_pipeline(X_dev, y_dev, X_sel):
     return clf.predict_proba(X_sel[:, sup])[:, 1], sup
 
 
+def test_svc_fold_map_sequential_branch_matches_vmap(cohort_full, monkeypatch):
+    """Above the lane-memory budget the SVC fold fan-out runs as a
+    sequential lax.map (the on-chip OOM fix at cohort scale); it must
+    produce the same meta-features as the vmapped branch."""
+    from machine_learning_replications_tpu.config import SVCConfig
+    from machine_learning_replications_tpu.data.schema import selected_indices
+    from machine_learning_replications_tpu.models import pipeline as pl
+
+    X, y, _ = cohort_full
+    Xs = np.asarray(X[:300, selected_indices()])
+    ys = np.asarray(y[:300])
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=5), svc=SVCConfig(platt_cv=2, max_iter=400)
+    )
+    meta_vmap = pl.cross_val_member_probas(Xs, ys, cfg)
+    monkeypatch.setattr(pl, "_SVC_VMAP_BYTES_BUDGET", 1)  # force lax.map
+    meta_seq = pl.cross_val_member_probas(Xs, ys, cfg)
+    np.testing.assert_allclose(meta_seq, meta_vmap, rtol=1e-6, atol=1e-9)
+
+    # ...and in the subsampled scaled regime (physical per-fold subsets)
+    cfg_sub = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=5),
+        svc=SVCConfig(platt_cv=2, max_iter=400, max_rows=180),
+    )
+    meta_sub_seq = pl.cross_val_member_probas(Xs, ys, cfg_sub)
+    monkeypatch.setattr(pl, "_SVC_VMAP_BYTES_BUDGET", 2 << 30)
+    meta_sub_vmap = pl.cross_val_member_probas(Xs, ys, cfg_sub)
+    np.testing.assert_allclose(meta_sub_seq, meta_sub_vmap, rtol=1e-6, atol=1e-9)
+
+
 def test_vmapped_meta_features_match_loop(cohort_full):
     """The vmapped fold fan-out (one XLA program per member for all k
     folds — ``svc_fit_masked`` / ``gbdt.fit_folds`` / masked FISTA) must
