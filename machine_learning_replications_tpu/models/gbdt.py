@@ -61,6 +61,31 @@ def fit(
     """Fit the boosted ensemble; returns (params, aux) with the deviance path."""
     resolve_backend(cfg)  # validate eagerly, even on paths that ignore it
     if bins is None:
+        if cfg.splitter == "hist" and cfg.max_depth == 1 \
+                and X.shape[0] >= DEVICE_BINNING_MIN_ROWS:
+            # Fused regime: binning + sorted layout + all boosting stages in
+            # ONE jitted program. The pieces are individually cheap at this
+            # scale but each separate blocking dispatch pays a full host
+            # round trip (~70 ms on the tunneled backend — measured r3);
+            # unfused, dispatch overhead exceeded the actual device work
+            # severalfold. aux carries the deviance as a device array for
+            # the same reason (callers np.asarray it if they want it).
+            fused = _fit_hist1_fused(
+                jnp.asarray(X), jnp.asarray(y),
+                n_bins=cfg.n_bins,
+                n_stages=cfg.n_estimators,
+                learning_rate=cfg.learning_rate,
+                min_samples_split=cfg.min_samples_split,
+                min_samples_leaf=cfg.min_samples_leaf,
+            )
+            feature, threshold, value, is_split, deviance, f0, nan_flag = fused
+            if bool(nan_flag):  # the one sync; NaN contract of bin_features
+                raise ValueError("input contains NaN; impute before binning")
+            params = forest_to_params(
+                feature, threshold, value, is_split,
+                init_raw=f0, learning_rate=cfg.learning_rate, max_depth=1,
+            )
+            return params, {"train_deviance": deviance}
         bins = default_bins(X, cfg)
     if cfg.max_depth == 1:
         # Gather/scatter-free fast path: replicated sorted layout
@@ -302,6 +327,51 @@ def _fit_stumps(
         min_samples_leaf=min_samples_leaf,
     )
     return carry[1:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_bins", "n_stages", "learning_rate",
+        "min_samples_split", "min_samples_leaf",
+    ),
+)
+def _fit_hist1_fused(
+    Xj: jnp.ndarray,
+    yj: jnp.ndarray,
+    *,
+    n_bins: int,
+    n_stages: int,
+    learning_rate: float,
+    min_samples_split: int,
+    min_samples_leaf: int,
+):
+    """Quantile binning → sorted stump layout → all boosting stages, fused
+    into a single XLA program (one dispatch, one device sync for the whole
+    fit). Equals ``bin_features_device`` + ``build_stump_data_device`` +
+    ``_fit_stumps`` run separately — pinned by
+    ``tests/test_gbdt_train.py::test_fused_hist1_matches_unfused``.
+
+    NaN handling: a traced program cannot raise, so the binning core's
+    ``nan_flag`` rides along as an output and ``fit`` checks it once at the
+    end (by then the answer is already computed — the check costs nothing
+    extra on top of the sync the caller needs anyway).
+    """
+    binned, mids, nan_flag = binning.device_binning_core(Xj, n_bins)
+    bins = binning.BinnedFeatures(
+        binned=binned, thresholds=mids.T,
+        n_bins=np.full(Xj.shape[1], n_bins, np.int32),
+    )
+    sd = histogram.build_stump_data_device(bins, yj)
+    feature, threshold, value, is_split, deviance = _fit_stumps(
+        sd,
+        n_stages=n_stages,
+        learning_rate=learning_rate,
+        min_samples_split=min_samples_split,
+        min_samples_leaf=min_samples_leaf,
+    )
+    f0 = _prior_log_odds(yj)
+    return feature, threshold, value, is_split, deviance, f0, nan_flag
 
 
 def _stump_init(sd: histogram.StumpData, n_stages: int):

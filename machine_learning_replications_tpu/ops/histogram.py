@@ -22,6 +22,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from machine_learning_replications_tpu.ops import binning
+
 # sklearn's impurity-is-zero leaf test: impurity <= EPSILON (np.finfo(double).eps)
 IMPURITY_EPS = 2.220446049250313e-16
 _IMPURITY_EPS = IMPURITY_EPS
@@ -115,19 +117,32 @@ def build_stump_data_device(bins, y, dtype=None) -> StumpData:
     bin_dtype = (
         jnp.uint8 if B <= 256 else jnp.uint16 if B <= 65536 else jnp.int32
     )
+    bb = b.astype(bin_dtype)  # narrow BEFORE the layout gather: it moves
+    #   F× the matrix, and gathering int32 just to cast after measured ~2×
+    #   the bytes and time of gathering the narrow ids (v5e, 1M rows)
     order = jnp.argsort(b, axis=0, stable=True)          # [n, F]
     # bins_x[fq, fs, i] = b[order[i, fs], fq]: one gather + transpose.
-    bins_x = jnp.transpose(b[order.T, :], (2, 0, 1)).astype(bin_dtype)
+    bins_x = jnp.transpose(bb[order.T, :], (2, 0, 1))
     y_sorted = jnp.take_along_axis(
         jnp.broadcast_to(jnp.asarray(y)[None, :], (F, n)), order.T, axis=1
     )
-    # left_count[f, b] = #rows with bin ≤ b — searchsorted on each sorted
-    # column (positions are static data, so this replaces host bincounts).
-    bins_sorted = jnp.take_along_axis(b, order, axis=0)  # [n, F] cols sorted
+    # left_count[f, b] = #rows with bin ≤ b — order-independent, so it comes
+    # from a chunked compare+sum histogram over the UNSORTED ids (one dense
+    # VPU pass) rather than a row gather into sorted order + searchsorted
+    # (TPU serializes both the 17M-element gather and the binary-search
+    # gathers; measured slower than the rest of the layout build combined).
     boundaries = jnp.arange(B - 1, dtype=b.dtype)
-    left_count = jax.vmap(
-        lambda col: jnp.searchsorted(col, boundaries, side="right")
-    )(bins_sorted.T).astype(jnp.int32)                   # [F, B-1]
+    # padding rows must not count: bin B-1 exceeds every boundary (they run
+    # to B-2 only), so the pad value is reduction-neutral by construction.
+    mapped, _ = binning.chunked_row_reduce(
+        b,
+        lambda bc: jnp.sum(
+            bc[:, None, :] <= boundaries[None, :, None],
+            axis=0, dtype=jnp.int32,
+        ),
+        pad_value=B - 1,
+    )
+    left_count = jnp.sum(mapped, axis=0).T.astype(jnp.int32)  # [F, B-1]
     thresholds = jnp.asarray(bins.thresholds)
     ys = y_sorted
     if dtype is not None:
@@ -139,16 +154,49 @@ def build_stump_data_device(bins, y, dtype=None) -> StumpData:
     )
 
 
+_BLOCKED_BOUNDARY_MIN_N = 16_384
+_BOUNDARY_BLOCK = 512
+
+
 def cumulative_boundary_sums(
     v_sorted: jnp.ndarray, left_count: jnp.ndarray
 ) -> jnp.ndarray:
     """``out[f, b] = Σ v over rows with bin[f] ≤ b`` from per-feature-sorted
-    values: one cumsum + one static lookup. ``v_sorted`` is ``[F, n]``."""
-    csum = jnp.cumsum(v_sorted, axis=1)
-    padded = jnp.concatenate(
-        [jnp.zeros((csum.shape[0], 1), csum.dtype), csum], axis=1
-    )
-    return jnp.take_along_axis(padded, left_count, axis=1)
+    values. ``v_sorted`` is ``[F, n]``; ``left_count`` holds the boundary
+    positions (counts in ``[0, n]``).
+
+    Small n: one cumsum + a static lookup — sequential summation order,
+    bitwise-stable against the parity oracles. Large n: TPU lowers the full
+    cumsum to O(log n) whole-array passes, which dominated the boosting
+    stage (~1.3 ms/stage at 200k rows), yet only B−1 prefix values are ever
+    read. The blocked path does one block-sum pass + a tiny per-block
+    cumsum, then reconstructs each boundary as (exclusive block prefix) +
+    (masked partial of one block) — 2 passes over the data instead of
+    log n. Summation regroups per block, so float results can differ from
+    the sequential path in the last ulp; the threshold keeps every parity
+    regime (reference cohort, fold tests) on the sequential path.
+    """
+    F, n = v_sorted.shape
+    if n < _BLOCKED_BOUNDARY_MIN_N:
+        csum = jnp.cumsum(v_sorted, axis=1)
+        padded = jnp.concatenate(
+            [jnp.zeros((csum.shape[0], 1), csum.dtype), csum], axis=1
+        )
+        return jnp.take_along_axis(padded, left_count, axis=1)
+
+    blk = _BOUNDARY_BLOCK
+    nb = -(-n // blk)
+    vp = jnp.pad(v_sorted, ((0, 0), (0, nb * blk - n)))
+    vb = vp.reshape(F, nb, blk)
+    block_sums = jnp.sum(vb, axis=2)                      # [F, nb]
+    excl = jnp.cumsum(block_sums, axis=1) - block_sums    # exclusive prefix
+    p = left_count                                        # [F, B-1]
+    bidx = jnp.minimum(p // blk, nb - 1)                  # clamp p == n edge
+    offset = p - bidx * blk                               # in [0, blk]
+    part = jnp.take_along_axis(vb, bidx[:, :, None], axis=1)  # [F, B-1, blk]
+    within = jnp.arange(blk, dtype=p.dtype)[None, None, :] < offset[:, :, None]
+    partial = jnp.sum(jnp.where(within, part, 0), axis=2)
+    return jnp.take_along_axis(excl, bidx, axis=1) + partial
 
 
 class NodeHistograms(NamedTuple):

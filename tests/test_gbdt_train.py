@@ -182,3 +182,67 @@ def test_device_stump_layout_equals_host_build(train_data):
                 np.asarray(getattr(host, name)), np.asarray(getattr(dev, name)),
                 err_msg=f"{name} (bin budget {budget})",
             )
+
+
+def test_fused_hist1_matches_unfused(train_data, monkeypatch):
+    """The one-program fused fit (binning + layout + boosting in a single
+    XLA dispatch — the device-binning regime's fast path) must equal the
+    same pieces run separately through an explicit ``bins=`` argument."""
+    from machine_learning_replications_tpu.ops import binning
+
+    X, y = train_data
+    # Drop the row threshold so the fused route engages at test size.
+    monkeypatch.setattr(gbdt, "DEVICE_BINNING_MIN_ROWS", 1)
+    cfg = GBDTConfig(n_estimators=8, splitter="hist", n_bins=32)
+    fused, aux_f = gbdt.fit(X, y, cfg)
+    unfused, aux_u = gbdt.fit(X, y, cfg, bins=binning.bin_features_device(X, 32))
+    for name in ("feature", "threshold", "value", "left", "right"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fused, name)), np.asarray(getattr(unfused, name)),
+            err_msg=name,
+        )
+    np.testing.assert_allclose(
+        float(fused.init_raw), float(unfused.init_raw), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(aux_f["train_deviance"]), np.asarray(aux_u["train_deviance"]),
+        rtol=1e-6,
+    )
+    # NaN contract survives the fusion (the flag is checked post-hoc).
+    Xn = X.copy()
+    Xn[0, 0] = np.nan
+    with pytest.raises(ValueError, match="NaN"):
+        gbdt.fit(Xn, y, cfg)
+
+
+def test_blocked_boundary_sums_match_sequential():
+    """Above ``_BLOCKED_BOUNDARY_MIN_N`` the boundary sums switch to the
+    two-level block decomposition; it must agree with the sequential-cumsum
+    oracle (exactly on integer-valued data, closely on floats)."""
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.ops import histogram
+
+    rng = np.random.default_rng(7)
+    F, B = 6, 37
+    n = histogram._BLOCKED_BOUNDARY_MIN_N + 1234  # force the blocked path
+    lc = rng.integers(0, n + 1, size=(F, B)).astype(np.int32)
+    lc[0, 0], lc[0, 1] = 0, n  # pin both edge positions
+    vi = rng.integers(-3, 4, size=(F, n)).astype(np.float32)
+    out = np.asarray(
+        histogram.cumulative_boundary_sums(jnp.asarray(vi), jnp.asarray(lc))
+    )
+    ref = np.stack(
+        [np.concatenate([[0], np.cumsum(vi[f].astype(np.int64))])[lc[f]]
+         for f in range(F)]
+    )
+    np.testing.assert_array_equal(out, ref.astype(np.float32))
+    vf = rng.normal(size=(F, n)).astype(np.float32)
+    out_f = np.asarray(
+        histogram.cumulative_boundary_sums(jnp.asarray(vf), jnp.asarray(lc))
+    )
+    ref_f = np.stack(
+        [np.concatenate([[0], np.cumsum(vf[f].astype(np.float64))])[lc[f]]
+         for f in range(F)]
+    )
+    np.testing.assert_allclose(out_f, ref_f, atol=5e-3)
