@@ -471,22 +471,60 @@ def device_leg_inference(args) -> dict:
 
     params = import_stacking(decode_pickle(REFERENCE_PKL_PATH))
     x1 = patient_row().reshape(1, -1)
-    predict = jax.jit(stacking.predict_proba1)
+    predict = jax.jit(lambda p, x: stacking.predict_proba1(p, x)[0])
+    # End-to-end like the reference flow (predict_hf.py PRINTS the number:
+    # the host must receive it): the timed unit is patient-row in →
+    # probability scalar on host, including the device→host result fetch.
+    # Device-only completion is recorded alongside for diagnosis — on a
+    # tunneled backend the fetch can dominate, and hiding it would make
+    # the latency claim unusable for a real client.
+    e2e_s = _median_time(lambda: float(predict(params, x1)), args.repeats * 10)
     dev_s = _median_time(
         lambda: jax.block_until_ready(predict(params, x1)), args.repeats * 10
     )
     np_params = jax.tree.map(np.asarray, params)
     cpu_s = _median_time(lambda: _numpy_stacked_predict(np_params, x1), args.repeats * 10)
-    prob = float(predict(params, x1)[0])
-    return {
+    prob = float(predict(params, x1))
+
+    # Batch regime: the same stacked graph over a cohort-scale matrix.
+    # Single-patient offload is round-trip-bound by construction (a
+    # 17-feature closed form cannot amortize any link), so the artifact
+    # carries the throughput point where a device makes sense at all.
+    import jax.numpy as jnp
+
+    nb = 100_000
+    rng = np.random.default_rng(2020)
+    Xb = (x1 + rng.normal(0, 0.05, size=(nb, x1.shape[1]))).astype(np.float32)
+    predict_b = jax.jit(stacking.predict_proba1)
+    Xb_d = jax.device_put(jnp.asarray(Xb))
+    batch_s = _median_time(
+        lambda: float(jnp.sum(predict_b(params, Xb_d))), args.repeats
+    )
+    cpu_batch_s = _median_time(
+        lambda: _numpy_stacked_predict(np_params, Xb.astype(np.float64)).sum(),
+        args.repeats,
+    )
+
+    rec = {
         "metric": "stacked_inference_latency_1patient",
-        "value": round(dev_s * 1e3, 4),
+        "value": round(e2e_s * 1e3, 4),
         "unit": "ms",
-        "vs_baseline": round(cpu_s / dev_s, 3),
+        "vs_baseline": round(cpu_s / e2e_s, 3),
         "baseline_ms": round(cpu_s * 1e3, 4),
+        "device_only_ms": round(dev_s * 1e3, 4),
         "probability_pct": round(100 * prob, 2),
+        "batch100k_rows_per_s": round(nb / batch_s, 1),
+        "batch100k_vs_numpy": round(cpu_batch_s / batch_s, 3),
         "device": _device_kind(),
     }
+    if e2e_s > cpu_s:
+        rec["note"] = (
+            "single-patient latency is host-link round-trip-bound "
+            "(~70 ms on the tunneled backend; the predict itself is "
+            "device_only-dominated by the same RTT) — see "
+            "batch100k_* for the throughput regime"
+        )
+    return rec
 
 
 def _numpy_stacked_predict(p, X):

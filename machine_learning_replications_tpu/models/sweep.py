@@ -19,6 +19,7 @@ are comparable against a ``GridSearchCV(scoring='roc_auc')`` differential.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -27,8 +28,8 @@ import numpy as np
 
 from machine_learning_replications_tpu.config import GBDTConfig, SweepConfig
 from machine_learning_replications_tpu.models import gbdt, tree
-from machine_learning_replications_tpu.utils import metrics
 from machine_learning_replications_tpu.utils.cv import stratified_kfold_test_masks
+from machine_learning_replications_tpu.utils.metrics import roc_auc_batch_host
 
 
 def staged_proba1(
@@ -42,6 +43,20 @@ def staged_proba1(
     idx = jnp.asarray(np.asarray(stages, dtype=np.int32) - 1)
     raw = params.init_raw + params.learning_rate * cum[idx]
     return jax.scipy.special.expit(raw)
+
+
+@functools.lru_cache(maxsize=None)
+def _staged_fold_jit(est_grid: tuple):
+    """Jitted (params, X_te, kk) → staged fold probabilities ``[E, n_te]``.
+
+    Cached per estimator grid so repeated sweeps reuse the compilation;
+    distinct test-fold sizes (n % k ≠ 0 gives two) compile once each."""
+
+    def f(params: tree.TreeEnsembleParams, X_te, kk):
+        p_k = jax.tree.map(lambda a: a[kk], params)
+        return staged_proba1(p_k, X_te, est_grid)
+
+    return jax.jit(f)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,19 +99,25 @@ def cv_sweep(
     test_masks = stratified_kfold_test_masks(y, sweep.cv_folds)
     train_masks = 1.0 - test_masks
     k = sweep.cv_folds
-    Xj = jnp.asarray(X)
 
     fold_auc = np.zeros((len(depth_grid), len(est_grid), k))
+    staged_fold = _staged_fold_jit(est_grid)
     for di, depth in enumerate(depth_grid):
         cfg = dataclasses.replace(base, n_estimators=m_max, max_depth=depth)
         params = gbdt.fit_folds(X, y, train_masks, cfg)
-        probs = np.asarray(
-            jax.vmap(lambda p: staged_proba1(p, Xj, est_grid))(params)
-        )  # [k, n_estimators, n]
         for kk, tm in enumerate(test_masks):
             te = tm > 0.5
-            for ei in range(len(est_grid)):
-                fold_auc[di, ei, kk] = float(metrics.roc_auc(y[te], probs[kk, ei, te]))
+            # Score each fold's HELD-OUT rows only: staging over the full
+            # matrix then masking threw away 1−1/k of the tree-apply work
+            # (measured ~4 s of an 8.6 s sweep at 20k rows). The fold
+            # slice of the batched params happens inside the jit — eager
+            # per-leaf indexing costs a dispatch round trip per leaf.
+            probs = np.asarray(staged_fold(params, X[te], kk))  # [E, n_te]
+            # Grid selection is a host-side decision (GridSearchCV's
+            # cv_results_ analogue); the vectorized rank AUC evaluates all
+            # n_estimators cells in one pass and matches
+            # metrics.roc_auc's tie-averaged U statistic exactly.
+            fold_auc[di, :, kk] = roc_auc_batch_host(y[te], probs)
 
     mean_auc = fold_auc.mean(axis=-1)
     di, ei = np.unravel_index(np.argmax(mean_auc), mean_auc.shape)

@@ -21,6 +21,7 @@ sketch); training afterwards touches only the int32 bin matrix on device.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import numpy as np
 
@@ -166,19 +167,15 @@ def device_binning_core(Xj, n_bins: int):
     return binned, mids, nan_flag
 
 
-_JIT_CACHE: dict = {}
-
-
+@functools.lru_cache(maxsize=None)
 def _device_binning_core_jit():
-    """Module-cached ``jit`` of the binning core: eager execution issues one
+    """Cached ``jit`` of the binning core: eager execution issues one
     tunneled dispatch per op on the remote TPU backend (~30 s of round
     trips at 1M rows for ~0.1 s of device work, measured r3); jax stays a
     function-local import per this module's loading discipline."""
-    if "core" not in _JIT_CACHE:
-        import jax
+    import jax
 
-        _JIT_CACHE["core"] = jax.jit(device_binning_core, static_argnums=1)
-    return _JIT_CACHE["core"]
+    return jax.jit(device_binning_core, static_argnums=1)
 
 
 def bin_features_device(X, n_bins: int = 256) -> BinnedFeatures:

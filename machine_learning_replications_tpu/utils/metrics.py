@@ -44,6 +44,29 @@ def roc_auc(y_true: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
     return u / (n_pos * n_neg)
 
 
+def roc_auc_batch_host(y_true, scores) -> "np.ndarray":
+    """Tie-averaged rank AUC over a batch of score rows ``[L, m]`` → ``[L]``,
+    in host numpy (scipy ``rankdata`` along the row axis).
+
+    The same U statistic as ``roc_auc`` (tested against it), for host-side
+    model-selection tables — e.g. the sweep's 45-cell grid, where one
+    device dispatch + fetch per cell costs more than the whole evaluation.
+    Mirrors ``roc_auc``'s empty-class contract by returning NaN rows
+    rather than warning."""
+    import numpy as np
+    from scipy.stats import rankdata
+
+    y = np.asarray(y_true, np.float64)
+    n_pos = y.sum()
+    n_neg = y.size - n_pos
+    scores = np.atleast_2d(np.asarray(scores, np.float64))
+    if n_pos == 0 or n_neg == 0:
+        return np.full(scores.shape[0], np.nan)
+    r = rankdata(scores, axis=-1, method="average")
+    u = (r * y[None, :]).sum(axis=-1) - n_pos * (n_pos + 1.0) / 2.0
+    return u / (n_pos * n_neg)
+
+
 class RocCurve(NamedTuple):
     """Fixed-length ROC scan: point k uses the top-k scores as positives."""
 

@@ -241,3 +241,39 @@ def test_sharded_exact_high_cardinality(cohort_full):
     np.testing.assert_allclose(
         np.asarray(sharded.value), np.asarray(single.value), rtol=1e-5, atol=1e-6
     )
+
+
+def test_sharded_blocked_boundary_path_equals_single_device(train_data, monkeypatch):
+    """The blocked boundary-sum decomposition (engaged above
+    ``_BLOCKED_BOUNDARY_MIN_N`` local rows — every bench-scale shard) must
+    stay semantically invisible under the psum'd sharded trainer. The
+    standard mesh tests run below the threshold, so this lowers it to force
+    the blocked path on both the single-device reference and the shards."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from machine_learning_replications_tpu.ops import histogram
+
+    monkeypatch.setattr(histogram, "_BLOCKED_BOUNDARY_MIN_N", 16)
+    monkeypatch.setattr(histogram, "_BOUNDARY_BLOCK", 32)
+    # The thresholds are read at TRACE time inside jitted trainers whose
+    # caches key on shapes only — flush before AND after so (a) an earlier
+    # same-signature compilation cannot silently bypass the patched values
+    # and (b) blocked-path executables don't leak to later parity tests.
+    jax.clear_caches()
+    try:
+        X, y = train_data
+        cfg = GBDTConfig(n_estimators=12, max_depth=1)
+        ref, _ = gbdt.fit(X, y, cfg)
+        mesh = make_mesh(data=4, model=2)
+        sh, _ = stump_trainer.fit(mesh, X, y, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(sh.feature), np.asarray(ref.feature)
+        )
+        np.testing.assert_allclose(
+            np.asarray(sh.threshold), np.asarray(ref.threshold), rtol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(sh.value), np.asarray(ref.value), rtol=1e-7, atol=1e-10
+        )
+    finally:
+        jax.clear_caches()

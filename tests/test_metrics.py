@@ -81,3 +81,27 @@ def test_wald_ci_matches_reference_formula():
         1.96 * np.sqrt(p * (1 - p) / 100),
         rtol=1e-12,
     )
+
+
+def test_roc_auc_batch_host_matches_device_and_sklearn():
+    """The host batched rank AUC (sweep's grid evaluator) must agree with
+    the device roc_auc and sklearn exactly, ties included, and mirror the
+    empty-class NaN contract."""
+    import numpy as np
+    from sklearn.metrics import roc_auc_score
+
+    from machine_learning_replications_tpu.utils.metrics import (
+        roc_auc,
+        roc_auc_batch_host,
+    )
+
+    rng = np.random.default_rng(11)
+    y = (rng.random(400) < 0.3).astype(np.float64)
+    scores = np.round(rng.random((6, 400)), 2)  # heavy ties
+    batch = roc_auc_batch_host(y, scores)
+    for i in range(scores.shape[0]):
+        np.testing.assert_allclose(batch[i], roc_auc_score(y, scores[i]), rtol=1e-12)
+        np.testing.assert_allclose(
+            batch[i], float(roc_auc(y, scores[i])), rtol=1e-6
+        )
+    assert np.isnan(roc_auc_batch_host(np.zeros(5), scores[:, :5])).all()
