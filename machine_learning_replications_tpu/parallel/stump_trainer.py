@@ -118,11 +118,21 @@ def _fit_raw(
     )
     bl_ext = jnp.pad(bl_ext, ((0, 0), (0, F_pad - F)))
     fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
-    w_real = (
-        jnp.ones(n, fdt) if sample_weight is None
-        else jnp.asarray(sample_weight).astype(fdt)
-    )
-    w_pad = jnp.pad(w_real, (0, n_pad - n))
+    # Uniform weights + no padding rows ⇒ the weighted machinery is dead
+    # code inside the loop (see ``weighted=`` below); don't build and ship
+    # a full-length all-ones array the program never reads — at 10M rows
+    # that is ~40 MB through a ~17 MB/s host link, per fit. A [n_data]
+    # placeholder keeps the sharded operand shape valid at one scalar per
+    # shard.
+    weighted = not (sample_weight is None and n_pad == n)
+    if weighted:
+        w_real = (
+            jnp.ones(n, fdt) if sample_weight is None
+            else jnp.asarray(sample_weight).astype(fdt)
+        )
+        w_pad = jnp.pad(w_real, (0, n_pad - n))
+    else:
+        w_pad = jnp.zeros(n_data, fdt)
     y_pad = jnp.pad(jnp.asarray(y).astype(fdt), (0, n_pad - n))
     thresholds = jnp.pad(
         jnp.asarray(bins.thresholds).astype(fdt), ((0, F_pad - F), (0, 0)),
@@ -142,6 +152,7 @@ def _fit_raw(
         learning_rate=cfg.learning_rate,
         min_samples_leaf=cfg.min_samples_leaf,
         min_samples_split=cfg.min_samples_split,
+        weighted=weighted,
     )
 
 
@@ -185,7 +196,8 @@ def fit(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "mesh", "n_stages", "learning_rate", "min_samples_leaf", "min_samples_split",
+        "mesh", "n_stages", "learning_rate", "min_samples_leaf",
+        "min_samples_split", "weighted",
     ),
 )
 def _fit_sharded(
@@ -200,6 +212,7 @@ def _fit_sharded(
     learning_rate: float,
     min_samples_leaf: int,
     min_samples_split: int,
+    weighted: bool = True,
 ):
     from jax import shard_map
 
@@ -228,18 +241,32 @@ def _fit_sharded(
         ys = jnp.take_along_axis(
             jnp.broadcast_to(yl[None, :], order.T.shape), order.T, axis=1
         ).astype(dtype)                                       # [F_loc, n_local]
-        ws = jnp.take_along_axis(
-            jnp.broadcast_to(wl[None, :], order.T.shape), order.T, axis=1
-        ).astype(dtype)
-        # Positional prefix boundaries: #rows with bin ≤ b. Padding rows
-        # carry bin B-1 so they sort last and sit past every boundary; a
-        # padded feature slot's constant-0 column gives lc = n_local, which
-        # its +inf thresholds make unreachable (valid=False).
-        cols_sorted = jnp.take_along_axis(cols, order, axis=0)
+        if weighted:
+            ws = jnp.take_along_axis(
+                jnp.broadcast_to(wl[None, :], order.T.shape), order.T, axis=1
+            ).astype(dtype)
+        else:
+            # No sample weights and no padding rows (n_pad == n, checked by
+            # the caller): the ws layout gather (~17M scattered reads at
+            # 10M rows) and the two per-stage [F, n] mask multiplies are
+            # pure overhead — every row is real with weight 1.
+            ws = None
+        # Positional prefix boundaries: #rows with bin ≤ b, from a chunked
+        # compare+sum histogram over the UNSORTED local columns — the old
+        # sorted-gather + vmapped searchsorted lowered to serialized
+        # dynamic gathers (the same pathology ops.binning documents).
+        # Padding rows carry bin B-1 > every boundary so they never count;
+        # a padded feature slot's constant-0 column gives lc = n_local,
+        # which its +inf thresholds make unreachable (valid=False).
         bvals = jnp.arange(Bm1, dtype=cols.dtype)
-        lc = jax.vmap(
-            lambda c: jnp.searchsorted(c, bvals, side="right")
-        )(cols_sorted.T).astype(jnp.int32)                    # [F_loc, B-1]
+        lc_mapped, _ = binning.chunked_row_reduce(
+            cols,
+            lambda cc: jnp.sum(
+                cc[:, None, :] <= bvals[None, :, None], axis=0, dtype=jnp.int32
+            ),
+            pad_value=np.asarray(Bm1, cols.dtype),
+        )
+        lc = jnp.sum(lc_mapped, axis=0).T.astype(jnp.int32)   # [F_loc, B-1]
         F_loc = F_loc_s
 
         def gsum(v):
@@ -251,8 +278,12 @@ def _fit_sharded(
                 (DATA_AXIS, MODEL_AXIS),
             )
 
-        n_real = gsum(ws[0])  # rows are real ⇔ w=1
-        sum_y = gsum(ys[0] * ws[0])
+        if weighted:
+            n_real = gsum(ws[0])  # rows are real ⇔ w=1
+            sum_y = gsum(ys[0] * ws[0])
+        else:
+            n_real = gsum(jnp.ones_like(ys[0]))
+            sum_y = gsum(ys[0])
         p1 = sum_y / n_real
         f0 = jnp.log(p1 / (1.0 - p1))
 
@@ -263,13 +294,21 @@ def _fit_sharded(
 
             return jax.lax.psum(cumulative_boundary_sums(v, lc), DATA_AXIS)
 
-        CL = cumb(ws)  # weights never change: hoisted out of the stage loop
+        if weighted:
+            CL = cumb(ws)  # weights don't change: hoisted out of the loop
+        else:
+            # Unweighted counts are exactly the positional boundaries.
+            CL = jax.lax.psum(lc.astype(dtype), DATA_AXIS)
 
         def stage(t, carry):
             raw, feats, thrs_o, vals, splits, devs = carry  # raw [F_loc, n_local]
             p = jax.scipy.special.expit(raw)
-            g = (ys - p) * ws
-            h = p * (1.0 - p) * ws
+            if weighted:
+                g = (ys - p) * ws
+                h = p * (1.0 - p) * ws
+            else:
+                g = ys - p
+                h = p * (1.0 - p)
             GL = cumb(g)
             HL = cumb(h)
             GT = gsum(g[0])
@@ -332,7 +371,8 @@ def _fit_sharded(
             contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
             raw = raw + learning_rate * contrib
 
-            ll = gsum((ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])) * ws[0])
+            ll_terms = ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])
+            ll = gsum(ll_terms * ws[0] if weighted else ll_terms)
             dev = -2.0 * ll / n_real
 
             feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)
