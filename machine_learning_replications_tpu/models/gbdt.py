@@ -62,7 +62,15 @@ def fit(
     resolve_backend(cfg)  # validate eagerly, even on paths that ignore it
     if bins is None:
         if cfg.splitter == "hist" and cfg.max_depth == 1 \
-                and X.shape[0] >= DEVICE_BINNING_MIN_ROWS:
+                and X.shape[0] >= DEVICE_BINNING_MIN_ROWS \
+                and not (
+                    isinstance(y, np.ndarray)
+                    and not histogram.is_binary_labels(y)
+                ):
+            # (host-side soft labels skip the fused path up front — its
+            # packed label column would be garbage and the post-dispatch
+            # status fallback would waste a full fit; device-resident
+            # labels keep the zero-pre-sync flag protocol below)
             # Fused regime: binning + sorted layout + all boosting stages in
             # ONE jitted program. The pieces are individually cheap at this
             # scale but each separate blocking dispatch pays a full host
@@ -78,14 +86,22 @@ def fit(
                 min_samples_split=cfg.min_samples_split,
                 min_samples_leaf=cfg.min_samples_leaf,
             )
-            feature, threshold, value, is_split, deviance, f0, nan_flag = fused
-            if bool(nan_flag):  # the one sync; NaN contract of bin_features
+            feature, threshold, value, is_split, deviance, f0, status = fused
+            # One sync for the whole fit. NaN is a contract violation
+            # everywhere; non-binary labels only invalidate the packed
+            # label column, so that case falls through to the gather-based
+            # path below — the common binary case pays no pre-dispatch
+            # label check, and soft-label fits keep working (they did
+            # before label packing existed).
+            code = int(status)
+            if code & 2:
                 raise ValueError("input contains NaN; impute before binning")
-            params = forest_to_params(
-                feature, threshold, value, is_split,
-                init_raw=f0, learning_rate=cfg.learning_rate, max_depth=1,
-            )
-            return params, {"train_deviance": deviance}
+            if not code & 1:
+                params = forest_to_params(
+                    feature, threshold, value, is_split,
+                    init_raw=f0, learning_rate=cfg.learning_rate, max_depth=1,
+                )
+                return params, {"train_deviance": deviance}
         bins = default_bins(X, cfg)
     if cfg.max_depth == 1:
         # Gather/scatter-free fast path: replicated sorted layout
@@ -362,7 +378,10 @@ def _fit_hist1_fused(
         binned=binned, thresholds=mids.T,
         n_bins=np.full(Xj.shape[1], n_bins, np.int32),
     )
-    sd = histogram.build_stump_data_device(bins, yj)
+    # Labels ride the layout's row gather as a packed bin column — valid
+    # only for exact-0/1 labels, so fold the check into the bad-input flag
+    # (binomial deviance requires binary labels anyway).
+    sd = histogram.build_stump_data_device(bins, yj, assume_binary_y=True)
     feature, threshold, value, is_split, deviance = _fit_stumps(
         sd,
         n_stages=n_stages,
@@ -371,7 +390,12 @@ def _fit_hist1_fused(
         min_samples_leaf=min_samples_leaf,
     )
     f0 = _prior_log_odds(yj)
-    return feature, threshold, value, is_split, deviance, f0, nan_flag
+    nonbin_flag = ~histogram.is_binary_labels(yj)
+    # One scalar status ships both conditions (each bool() fetch is a full
+    # host round trip on a tunneled backend): bit 1 = NaN input, bit 0 =
+    # non-binary labels.
+    status = nan_flag.astype(jnp.int32) * 2 + nonbin_flag.astype(jnp.int32)
+    return feature, threshold, value, is_split, deviance, f0, status
 
 
 def _stump_init(sd: histogram.StumpData, n_stages: int):

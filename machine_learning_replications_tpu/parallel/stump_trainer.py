@@ -59,7 +59,13 @@ MAX_LAYOUT_BYTES = 8 << 30
 
 
 def _layout_plan(n: int, F: int, max_bins: int, n_data: int, n_model: int):
-    """(F_pad, n_local, bin_dtype, bins_x bytes per shard) for a mesh shape."""
+    """(F_pad, n_local, bin_dtype, bins_x bytes per shard) for a mesh shape.
+
+    The byte estimate counts F_pad+1 gathered planes: binary labels ride
+    the bins matrix as one extra packed column (``_fit_raw``), and the
+    guard must be conservative for exactly the configuration that
+    allocates the most — an unpacked fit simply comes in ~1/F_pad under
+    the estimate."""
     F_pad = -(-F // n_model) * n_model
     n_local = -(-n // n_data)
     bin_dtype = (
@@ -67,7 +73,9 @@ def _layout_plan(n: int, F: int, max_bins: int, n_data: int, n_model: int):
         else np.uint16 if max_bins <= 65536
         else np.int32
     )
-    per_shard = F_pad * (F_pad // n_model) * n_local * np.dtype(bin_dtype).itemsize
+    per_shard = (
+        (F_pad + 1) * (F_pad // n_model) * n_local * np.dtype(bin_dtype).itemsize
+    )
     return F_pad, n_local, bin_dtype, per_shard
 
 
@@ -118,6 +126,18 @@ def _fit_raw(
     )
     bl_ext = jnp.pad(bl_ext, ((0, 0), (0, F_pad - F)))
     fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+    # Exact-0/1 labels ride the bins matrix as one extra packed column, so
+    # each shard recovers them from the layout's existing row gather
+    # instead of a separate scattered gather per sort order (~20% of the
+    # layout wall at 10M rows). Host labels are checked here; device
+    # labels cost one scalar fetch — still far cheaper than the gather.
+    from machine_learning_replications_tpu.ops.histogram import is_binary_labels
+
+    yj = jnp.asarray(y)
+    binary_y = bool(is_binary_labels(y if isinstance(y, np.ndarray) else yj))
+    if binary_y:
+        ybit = jnp.pad((yj > 0.5).astype(bin_dtype), (0, n_pad - n))
+        bl_ext = jnp.concatenate([bl_ext, ybit[:, None]], axis=1)
     # Uniform weights + no padding rows ⇒ the weighted machinery is dead
     # code inside the loop (see ``weighted=`` below); don't build and ship
     # a full-length all-ones array the program never reads — at 10M rows
@@ -133,7 +153,6 @@ def _fit_raw(
         w_pad = jnp.pad(w_real, (0, n_pad - n))
     else:
         w_pad = jnp.zeros(n_data, fdt)
-    y_pad = jnp.pad(jnp.asarray(y).astype(fdt), (0, n_pad - n))
     thresholds = jnp.pad(
         jnp.asarray(bins.thresholds).astype(fdt), ((0, F_pad - F), (0, 0)),
         constant_values=np.inf,
@@ -142,6 +161,10 @@ def _fit_raw(
     def put(a, spec):
         return jax.device_put(a, NamedSharding(mesh, spec))
 
+    if binary_y:
+        y_pad = jnp.zeros(n_data, fdt)  # dead operand; labels ride bl_ext
+    else:
+        y_pad = jnp.pad(yj.astype(fdt), (0, n_pad - n))
     return _fit_sharded(
         mesh,
         put(bl_ext, P(DATA_AXIS, None)),
@@ -153,6 +176,7 @@ def _fit_raw(
         min_samples_leaf=cfg.min_samples_leaf,
         min_samples_split=cfg.min_samples_split,
         weighted=weighted,
+        y_in_bins=binary_y,
     )
 
 
@@ -197,7 +221,7 @@ def fit(
     jax.jit,
     static_argnames=(
         "mesh", "n_stages", "learning_rate", "min_samples_leaf",
-        "min_samples_split", "weighted",
+        "min_samples_split", "weighted", "y_in_bins",
     ),
 )
 def _fit_sharded(
@@ -213,12 +237,13 @@ def _fit_sharded(
     min_samples_leaf: int,
     min_samples_split: int,
     weighted: bool = True,
+    y_in_bins: bool = False,
 ):
     from jax import shard_map
 
     Bm1 = thresholds.shape[-1]
     n_model = mesh.shape[MODEL_AXIS]
-    F_pad = bl_ext.shape[1]
+    F_pad = bl_ext.shape[1] - (1 if y_in_bins else 0)
     F_loc_s = F_pad // n_model
 
     def local_loop(bl, yl, wl, thr_full):
@@ -237,10 +262,15 @@ def _fit_sharded(
         order = jnp.argsort(cols, axis=0, stable=True)       # [n_local, F_loc]
         # bx[fq, fs, i] = bl[order[i, fs], fq] — every feature's bins in
         # every local sort order (split routing is a dense compare).
-        bx = jnp.transpose(bl[order.T, :], (2, 0, 1))        # [F_pad, F_loc, n]
-        ys = jnp.take_along_axis(
-            jnp.broadcast_to(yl[None, :], order.T.shape), order.T, axis=1
-        ).astype(dtype)                                       # [F_loc, n_local]
+        bx = jnp.transpose(bl[order.T, :], (2, 0, 1))  # [F_pad(+1), F_loc, n]
+        if y_in_bins:
+            # Labels came along as bl's last column — already in every
+            # local sort order via the row gather above.
+            ys = bx[F_pad].astype(dtype)                      # [F_loc, n_local]
+        else:
+            ys = jnp.take_along_axis(
+                jnp.broadcast_to(yl[None, :], order.T.shape), order.T, axis=1
+            ).astype(dtype)                                   # [F_loc, n_local]
         if weighted:
             ws = jnp.take_along_axis(
                 jnp.broadcast_to(wl[None, :], order.T.shape), order.T, axis=1

@@ -246,3 +246,26 @@ def test_blocked_boundary_sums_match_sequential():
          for f in range(F)]
     )
     np.testing.assert_allclose(out_f, ref_f, atol=5e-3)
+
+
+def test_fused_falls_back_on_nonbinary_labels(train_data, monkeypatch):
+    """The fused fit packs labels as a bin column, which is only valid for
+    exact-0/1 labels — soft labels (well-defined under binomial deviance)
+    must fall back to the label-gather path and train identically to an
+    explicit-bins fit, not raise and not silently truncate to bits."""
+    from machine_learning_replications_tpu.ops import binning
+
+    X, y = train_data
+    monkeypatch.setattr(gbdt, "DEVICE_BINNING_MIN_ROWS", 1)
+    y_soft = np.where(y > 0.5, 0.9, 0.1)
+    cfg = GBDTConfig(n_estimators=5, splitter="hist", n_bins=32)
+    fell_back, _ = gbdt.fit(X, y_soft, cfg)
+    explicit, _ = gbdt.fit(
+        X, y_soft, cfg, bins=binning.bin_features_device(X, 32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fell_back.feature), np.asarray(explicit.feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(fell_back.value), np.asarray(explicit.value), rtol=1e-6
+    )
