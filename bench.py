@@ -72,7 +72,7 @@ PARITY_TOL = 0.005  # BASELINE.json AUC budget
 # of backend (the device-side layout/binning rework made CPU-JAX fallback
 # legs fast enough at full size), so baseline legs are mode-independent and
 # can run while the TPU probe loop is still trying.
-DEFAULT_ROWS = {1: 1, 2: 1_000_000, 3: 1_000_000, 4: 20_000, 5: 10_000_000}
+DEFAULT_ROWS = {1: 1, 2: 1_000_000, 3: 1_000_000, 4: 50_000, 5: 10_000_000}
 # Config 5 on the CPU fallback keeps a reduced cohort: a 10M-row train on
 # 1-core CPU JAX exceeds any sane leg timeout (its baseline re-runs to match).
 DEGRADED_ROWS_C5 = 1_000_000
