@@ -76,7 +76,11 @@ DEFAULT_ROWS = {1: 1, 2: 1_000_000, 3: 1_000_000, 4: 50_000, 5: 10_000_000}
 # Config 5 on the CPU fallback keeps a reduced cohort: a 10M-row train on
 # 1-core CPU JAX exceeds any sane leg timeout (its baseline re-runs to match).
 DEGRADED_ROWS_C5 = 1_000_000
-DEVICE_TIMEOUT = {1: 420, 2: 600, 3: 780, 4: 900, 5: 1500}
+# Healthy device-leg walls (r3, uncontended): c1 ~17s, c2 ~75s, c3 ~100s,
+# c4 ~130s, c5 ~200-240s — plus remote-compile variance up to ~2x. The
+# timeout is ~3x healthy so ONE tunnel hang cannot eat half the budget
+# (r3: a hung c4 leg burned its whole former 900s allowance).
+DEVICE_TIMEOUT = {1: 300, 2: 420, 3: 540, 4: 450, 5: 900}
 BASELINE_TIMEOUT = {1: 0, 2: 420, 3: 700, 4: 900, 5: 900}
 
 # Chip datasheet anchors for the utilization accounting (VERDICT r2 item 4).
