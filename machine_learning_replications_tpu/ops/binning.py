@@ -140,6 +140,8 @@ def device_binning_core(Xj, n_bins: int):
     import jax.numpy as jnp
 
     n, F = Xj.shape
+    if n == 0:  # shape is static — this raises at trace time, not runtime
+        raise ValueError("device binning: zero-row input")
     nan_flag = jnp.isnan(Xj).any()
     Xs = jnp.sort(Xj, axis=0)                              # [n, F]
     q_idx = jnp.round(

@@ -269,3 +269,16 @@ def test_fused_falls_back_on_nonbinary_labels(train_data, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(fell_back.value), np.asarray(explicit.value), rtol=1e-6
     )
+
+
+def test_chunked_row_reduce_rejects_empty():
+    """The shared chunked scaffolding must fail loudly on zero-row input
+    (the old path died with an opaque ZeroDivisionError in a reshape)."""
+    from machine_learning_replications_tpu.ops import binning
+
+    with pytest.raises(ValueError, match="zero-row"):
+        binning.bin_features_device(np.empty((0, 4), np.float32), 16)
+    with pytest.raises(ValueError, match="zero-row"):
+        binning.chunked_row_reduce(
+            np.empty((0, 4), np.float32), lambda c: c.sum(0)
+        )
