@@ -59,6 +59,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -68,14 +69,22 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 PARITY_TOL = 0.005  # BASELINE.json AUC budget
 
 # Rows per config. Config 4's baseline is a 45-fit GridSearchCV on one CPU
-# core — it gets a smaller cohort by design. One size per config regardless
-# of backend (the device-side layout/binning rework made CPU-JAX fallback
-# legs fast enough at full size), so baseline legs are mode-independent and
-# can run while the TPU probe loop is still trying.
+# core — it gets a smaller cohort by design.
 DEFAULT_ROWS = {1: 1, 2: 1_000_000, 3: 1_000_000, 4: 50_000, 5: 10_000_000}
-# Config 5 on the CPU fallback keeps a reduced cohort: a 10M-row train on
-# 1-core CPU JAX exceeds any sane leg timeout (its baseline re-runs to match).
-DEGRADED_ROWS_C5 = 1_000_000
+# CPU-fallback legs run reduced cohorts. r3 post-mortem: at 1M rows the
+# degraded path costs 108 s (c2) + 138 s (c5) per device leg plus 3x-repeat
+# sklearn baselines, which cannot fit the budget slice that remains after
+# the probe loop — the rc=124 driver kill. 200k keeps every CPU leg under
+# ~45 s while still exercising the device-binning path
+# (>= gbdt.DEVICE_BINNING_MIN_ROWS).
+DEGRADED_ROWS = {2: 200_000, 3: 200_000, 5: 1_000_000}
+# Budget discipline (VERDICT r3 next-round item 1): all planned work fits
+# WORK_FRACTION of --budget — the driver's own clock kills at ~--budget, and
+# r3 planned right up to it, so the final JSON line never got printed. The
+# probe loop may spend at most PROBE_FRACTION before the run commits to the
+# degraded path, so the five CPU legs provably fit the remainder.
+WORK_FRACTION = 0.85
+PROBE_FRACTION = 0.40
 # Healthy device-leg walls (r3, uncontended): c1 ~17s, c2 ~75s, c3 ~100s,
 # c4 ~130s, c5 ~200-240s — plus remote-compile variance up to ~2x. The
 # timeout is ~3x healthy so ONE tunnel hang cannot eat half the budget
@@ -101,52 +110,96 @@ def log(msg: str) -> None:
 def clean_env() -> dict:
     """Interpreter env that cannot touch the TPU tunnel (shared recipe:
     ``machine_learning_replications_tpu.envsafe`` — importable here because
-    the package root only pulls in the pure-python config layer)."""
+    the package root only pulls in the pure-python config layer). CPU legs
+    additionally get a persistent XLA compilation cache so retry attempts
+    and repeat legs don't re-pay the trace+compile wall."""
     sys.path.insert(0, REPO)
     from machine_learning_replications_tpu.envsafe import clean_cpu_env
 
-    return clean_cpu_env()
+    env = clean_cpu_env()
+    cache = os.path.join(tempfile.gettempdir(), "mlr_tpu_xla_cache")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    except OSError:
+        pass
+    return env
 
 
-def probe_tpu(probe_log: list, timeout: int = 150) -> str | None:
+def _parse_probe_output(stdout: str) -> str | None:
+    """Parse a probe subprocess's stdout into a device-kind string, or None.
+
+    A ``PROBE_OK`` line counts only when the platform is an accelerator: a
+    healthy *CPU* backend must read as "TPU down" (VERDICT r3 missing #4 —
+    ``PROBE_OK cpu`` would otherwise set degraded=False and launch the
+    10M-row config 5 on single-core CPU jax, a guaranteed timeout).
+    """
+    for line in (stdout or "").splitlines():
+        if line.startswith("PROBE_OK"):
+            kind = line.split("PROBE_OK", 1)[1].strip()
+            platform = kind.split()[0] if kind.split() else ""
+            if platform and platform != "cpu":
+                return kind
+    return None
+
+
+def probe_tpu(probe_log: list, timeout: int = 150,
+              state: "_RunState | None" = None) -> str | None:
     """One attempt to initialize the ambient (TPU) backend in a fresh
     subprocess; outcome appended to ``probe_log`` (timestamped, shipped in
     the artifact so a hostile environment is provable — VERDICT r2 item 1).
 
     The hang is intermittent, so the *orchestrator* loops this between
-    other useful work instead of burning the budget up front.
+    other useful work instead of burning the budget up front. The child is
+    registered on ``state`` so a driver SIGTERM mid-probe (likely: the
+    probe loop owns up to 40% of the budget) reaps the hung interpreter
+    instead of orphaning it on the tunnel.
     """
     code = "import jax; d = jax.devices()[0]; print('PROBE_OK', d.platform, '|', d.device_kind, flush=True)"
     rec = {"t": time.strftime("%H:%M:%S"), "timeout_s": timeout}
     probe_log.append(rec)
     log(f"TPU probe attempt {len(probe_log)} (timeout {timeout}s)")
     t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    if state is not None:
+        state.child = proc
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            timeout=timeout, text=True,
-        )
+        stdout, _ = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
         rec.update(outcome="timeout", wall_s=round(time.perf_counter() - t0, 1))
         log("probe timed out (backend hang)")
         return None
+    finally:
+        if state is not None:
+            state.child = None
     rec["wall_s"] = round(time.perf_counter() - t0, 1)
-    for line in (r.stdout or "").splitlines():
-        if line.startswith("PROBE_OK"):
-            kind = line.split("PROBE_OK", 1)[1].strip()
-            rec.update(outcome="ok", device=kind)
-            log(f"TPU backend up: {kind}")
-            return kind
-    tail = (r.stdout or "").strip().splitlines()[-3:]
-    rec.update(outcome=f"rc={r.returncode}")
-    log(f"probe rc={r.returncode}: {' / '.join(tail)}")
+    kind = _parse_probe_output(stdout)
+    if kind is not None:
+        rec.update(outcome="ok", device=kind)
+        log(f"TPU backend up: {kind}")
+        return kind
+    if "PROBE_OK" in (stdout or ""):
+        # The backend answered but it is a CPU — the plugin failed over
+        # gracefully. That is a DOWN verdict for the accelerator.
+        rec.update(outcome="ok_but_cpu")
+        log("probe answered with a cpu backend — counting the TPU as down")
+        return None
+    tail = (stdout or "").strip().splitlines()[-3:]
+    rec.update(outcome=f"rc={proc.returncode}")
+    log(f"probe rc={proc.returncode}: {' / '.join(tail)}")
     return None
 
 
 def run_leg(
     leg: str, config: int, env: dict, timeout: int, extra: list[str],
     attempts: int = 2, deadline: float | None = None,
+    state: "_RunState | None" = None,
 ) -> dict:
     """Run one measurement leg in a subprocess; parse its JSON result file.
 
@@ -155,7 +208,8 @@ def run_leg(
     corrupt the stdout JSON contract. Returns {"error": ...} on failure.
     Every attempt's timeout is clamped to the orchestrator ``deadline`` so
     retries can never push the whole run past --budget (the no-JSON
-    rc=124 failure mode this harness exists to prevent).
+    rc=124 failure mode this harness exists to prevent). The live child is
+    registered on ``state`` so the SIGTERM flush handler can reap it.
     """
     last_err = "unknown"
     for i in range(attempts):
@@ -173,17 +227,23 @@ def run_leg(
         ] + extra
         log(f"{leg} leg c{config} attempt {i + 1}/{attempts} (timeout {timeout}s)")
         t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            cmd, cwd=REPO, env=env, stdout=sys.stderr, stderr=sys.stderr,
+        )
+        if state is not None:
+            state.child = proc
         try:
-            r = subprocess.run(
-                cmd, cwd=REPO, env=env, stdout=sys.stderr, stderr=sys.stderr,
-                timeout=timeout,
-            )
-            rc = r.returncode
+            rc = proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
             last_err = f"leg timed out after {timeout}s"
             log(last_err)
             os.unlink(out_path)
             continue
+        finally:
+            if state is not None:
+                state.child = None
         dt = time.perf_counter() - t0
         try:
             with open(out_path) as f:
@@ -200,41 +260,147 @@ def run_leg(
     return {"error": last_err}
 
 
+class _RunState:
+    """Everything the signal-flush handler needs to emit a (possibly
+    partial) artifact: results land here the moment each config finishes,
+    so a driver SIGTERM at any point still yields a JSON line carrying
+    every completed measurement (VERDICT r3 next-round item 1a — rc=124
+    arrived before the old 'JSON on every exit path' guarantee could fire
+    because the payload was only built at the very end)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.t_start = time.perf_counter()
+        self.results: dict[str, dict] = {}
+        self.probe_log: list[dict] = []
+        self.degraded = True
+        self.child: subprocess.Popen | None = None
+        self.flushed = False
+
+    def build_payload(self, partial: str | None = None) -> dict:
+        args, results = self.args, self.results
+        headline_cfg = str(args.config or 3)
+        head = results.get(headline_cfg, {"error": "headline config never ran"})
+        # parity_ok distinguishes checked-and-passed from never-checked: it
+        # is true only when ≥1 config ran its AUC parity check and none
+        # failed; parity_checked counts the configs that actually verified.
+        checked = [r for r in results.values() if "parity_ok" in r]
+        payload = {
+            "metric": head.get("metric", f"config{headline_cfg}_failed"),
+            "value": head.get("value", 0.0),
+            "unit": head.get("unit", "s"),
+            "vs_baseline": head.get("vs_baseline", 0.0),
+            "device": head.get("device", "unreachable"),
+            "parity_ok": bool(checked) and all(r["parity_ok"] for r in checked),
+            "parity_checked": len(checked),
+            "degraded_cpu_fallback": self.degraded,
+            "probe_attempts": len(self.probe_log),
+            "probe_log": self.probe_log,
+            "wall_s_total": round(time.perf_counter() - self.t_start, 1),
+        }
+        if partial:
+            payload["partial"] = partial
+        if len(results) > 1 or str(args.config or "") not in results:
+            payload["configs"] = results
+        else:
+            payload.update({k: v for k, v in head.items() if k not in payload})
+        errors = {c: r["error"] for c, r in results.items() if "error" in r}
+        if errors:
+            payload["errors"] = errors
+        return payload
+
+    def emit(self, partial: str | None = None) -> int:
+        if self.flushed:
+            return 1
+        self.flushed = True
+        payload = self.build_payload(partial)
+        print(json.dumps(payload), flush=True)
+        ok = partial is None and "error" not in \
+            self.results.get(str(self.args.config or 3), {"error": "never ran"}) \
+            and payload["parity_ok"]
+        return 0 if ok else 1
+
+
+def _install_flush_handlers(state: _RunState) -> None:
+    """SIGTERM (the driver's kill) and SIGALRM (our own backstop) both
+    flush whatever has been measured so far as the stdout JSON line, reap
+    the live leg subprocess, and exit. ``os._exit`` keeps the handler
+    re-entrancy-safe: nothing after the flush can corrupt stdout."""
+
+    def flush(signum, frame):
+        try:
+            child = state.child
+            if child is not None and child.poll() is None:
+                child.kill()
+        except Exception:
+            pass
+        rc = state.emit(partial=f"flushed on signal {signum} "
+                                f"({signal.Signals(signum).name})")
+        sys.stdout.flush()
+        os._exit(rc if rc else 1)
+
+    signal.signal(signal.SIGTERM, flush)
+    signal.signal(signal.SIGALRM, flush)
+
+
 def orchestrate(args) -> int:
-    t_start = time.perf_counter()
-    deadline = t_start + args.budget
+    state = _RunState(args)
+    _install_flush_handlers(state)
+    t_start = state.t_start
+    # All planned work fits in WORK_FRACTION of the budget; the SIGALRM
+    # backstop fires just before the driver's own clock would, flushing
+    # whatever exists. A clean run cancels the alarm at emit time.
+    deadline = t_start + WORK_FRACTION * args.budget
+    # The backstop must fire strictly AFTER the planned work deadline (the
+    # planner handles its own deadline; the alarm exists for overshoot) and
+    # strictly before the driver's kill at ~--budget.
+    alarm_s = int(min(args.budget - 5,
+                      max(WORK_FRACTION * args.budget + 30, args.budget - 90)))
+    signal.alarm(max(60, alarm_s))
     configs = [args.config] if args.config else [3, 1, 2, 5, 4]
-    probe_log: list[dict] = []
-    baselines: dict[int, dict] = {}
+    probe_log = state.probe_log
+    # Baselines keyed by (config, rows): a record is reusable only for the
+    # exact cohort size the surviving device leg ended up running.
+    baselines: dict[tuple[int, int], dict] = {}
 
     def rows_for(c: int, degraded_now: bool) -> int:
         if args.rows:
             return args.rows
-        if c == 5 and degraded_now:
-            return DEGRADED_ROWS_C5
+        if degraded_now and c in DEGRADED_ROWS:
+            return DEGRADED_ROWS[c]
         return DEFAULT_ROWS[c]
 
     def baseline_args(c: int, rows: int) -> list[str]:
         return ["--rows", str(rows), "--cpu-repeats", str(args.cpu_repeats),
                 "--baseline-rows", str(args.baseline_rows)]
 
+    def run_baseline(c: int, rows: int) -> dict:
+        key = (c, rows)
+        if key not in baselines or "error" in baselines[key]:
+            baselines[key] = run_leg(
+                "baseline", c, clean_env(), BASELINE_TIMEOUT[c],
+                baseline_args(c, rows), deadline=deadline, state=state,
+            )
+        return baselines[key]
+
     # --- phase 1: bring up the device backend --------------------------
     # One quick probe; if the backend hangs, keep probing — interleaved
     # with the (TPU-independent) sklearn baseline legs so the wait is never
-    # idle — until it answers or ~60% of the budget is gone. Timeouts cycle
-    # through one long (300s) attempt per round in case the backend is slow
-    # rather than hung. Every attempt lands in the artifact's probe_log.
-    kind = None if args.force_cpu else probe_tpu(probe_log, timeout=150)
+    # idle — until it answers or PROBE_FRACTION of the budget is gone.
+    # Timeouts cycle through one long (300s) attempt per round in case the
+    # backend is slow rather than hung. Every attempt lands in probe_log.
+    kind = None if args.force_cpu else probe_tpu(probe_log, timeout=150, state=state)
     if kind is None and not args.force_cpu:
-        probe_deadline = t_start + 0.6 * args.budget
-        # Config 1 measures its baseline in-leg. Config 5 interleaves LAST:
-        # its baseline rows depend on the outcome (10M if the TPU recovers,
-        # 1M degraded), so its interleaved record is reusable only in the
-        # recovered case — still worth doing with otherwise-idle probe
-        # time, but after the outcome-independent configs.
-        pending = [c for c in configs if c not in (1, 5)] + (
-            [5] if 5 in configs else []
-        )
+        probe_deadline = t_start + PROBE_FRACTION * args.budget
+        # Config 1 measures its baseline in-leg. Degraded-size baselines
+        # first (the likely outcome when the first probe already failed),
+        # most expensive first (c4's GridSearchCV is mode-independent);
+        # then the healthy-size records in case the TPU recovers.
+        pending = [(c, rows_for(c, True)) for c in (4, 3, 2, 5) if c in configs]
+        pending += [
+            (c, rows_for(c, False)) for c in (3, 2) if c in configs
+            and rows_for(c, False) != rows_for(c, True)
+        ]
         timeouts = [150, 300, 150, 150, 300]
         max_probes = 24  # hang-mode attempts are bounded by time anyway;
         #                  this bounds the fast-failure mode (rc!=0 in
@@ -242,23 +408,18 @@ def orchestrate(args) -> int:
         while kind is None and time.perf_counter() < probe_deadline \
                 and len(probe_log) < max_probes:
             if pending:
-                c = pending.pop(0)
-                rows = rows_for(c, degraded_now=False)
-                log(f"probe interleave: baseline leg c{c} while TPU is down")
-                baselines[c] = run_leg(
-                    "baseline", c, clean_env(), BASELINE_TIMEOUT[c],
-                    baseline_args(c, rows), deadline=deadline,
-                )
-                baselines[c]["_rows"] = rows
+                c, rows = pending.pop(0)
+                log(f"probe interleave: baseline leg c{c}@{rows} while TPU is down")
+                run_baseline(c, rows)
             elif probe_log[-1].get("wall_s", 0) < 30:
                 # fast failure, nothing useful to interleave: back off so a
-                # broken-plugin loop can't spin subprocesses for 60% of the
-                # budget (and flood probe_log)
+                # broken-plugin loop can't spin subprocesses for the whole
+                # probe window (and flood probe_log)
                 time.sleep(min(30, max(0, probe_deadline - time.perf_counter())))
             t = timeouts[(len(probe_log) - 1) % len(timeouts)]
             t = min(t, max(int(probe_deadline - time.perf_counter()), 60))
-            kind = probe_tpu(probe_log, timeout=t)
-    degraded = kind is None
+            kind = probe_tpu(probe_log, timeout=t, state=state)
+    state.degraded = degraded = kind is None
     if degraded:
         if not args.force_cpu:
             log(f"TPU unreachable after {len(probe_log)} probes — "
@@ -267,11 +428,12 @@ def orchestrate(args) -> int:
     else:
         device_env = dict(os.environ)
 
-    results: dict[str, dict] = {}
+    results = state.results
     for c in configs:
         remaining = deadline - time.perf_counter()
-        if remaining < 60:
-            results[str(c)] = {"error": f"skipped: budget exhausted ({args.budget}s)"}
+        if remaining < 45:
+            results[str(c)] = {"error": "skipped: budget exhausted "
+                               f"({int(WORK_FRACTION * args.budget)}s work window)"}
             log(f"config {c} skipped — budget exhausted")
             continue
 
@@ -288,35 +450,30 @@ def orchestrate(args) -> int:
                     "--trace", leg_trace]
 
         dev = run_leg("device", c, device_env, DEVICE_TIMEOUT[c],
-                      leg_args(rows, trace), deadline=deadline)
+                      leg_args(rows, trace), deadline=deadline, state=state)
         if "error" in dev and not degraded:
             # TPU leg failed twice. Re-probe (the tunnel may have dropped
             # mid-run): if the backend answers, one more TPU try; otherwise
             # fall back to a clean-env CPU leg so the artifact still carries
             # a measured number (flagged below).
             tpu_err = dev["error"]
-            if probe_tpu(probe_log, timeout=150):
+            if probe_tpu(probe_log, timeout=150, state=state):
                 log(f"config {c}: TPU leg failed but backend re-probes OK — retrying")
                 dev = run_leg("device", c, device_env, DEVICE_TIMEOUT[c],
-                              leg_args(rows, trace), attempts=1, deadline=deadline)
+                              leg_args(rows, trace), attempts=1, deadline=deadline,
+                              state=state)
             if "error" in dev:
                 log(f"config {c}: TPU leg failed, falling back to clean-env CPU")
                 cpu_rows = rows_for(c, degraded_now=True)
                 dev = run_leg("device", c, clean_env(), DEVICE_TIMEOUT[c],
-                              leg_args(cpu_rows, ""), attempts=1, deadline=deadline)
+                              leg_args(cpu_rows, ""), attempts=1, deadline=deadline,
+                              state=state)
                 dev["tpu_error"] = tpu_err
                 dev["device_fallback"] = "cpu"
                 rows = cpu_rows
 
         if c != 1 and "error" not in dev:
-            if c in baselines and baselines[c].get("_rows") == rows \
-                    and "error" not in baselines[c]:
-                base = baselines[c]
-            else:
-                base = run_leg(
-                    "baseline", c, clean_env(), BASELINE_TIMEOUT[c],
-                    baseline_args(c, rows), deadline=deadline,
-                )
+            base = run_baseline(c, rows)
         elif c == 1:
             base = {}  # config 1's numpy baseline is measured inside the leg
         else:
@@ -326,35 +483,8 @@ def orchestrate(args) -> int:
         log(f"config {c} result: {json.dumps(results[str(c)])[:400]}")
 
     # --- emit the single JSON line -------------------------------------
-    headline_cfg = str(args.config or 3)
-    head = results.get(headline_cfg, {"error": "headline config never ran"})
-    # parity_ok distinguishes checked-and-passed from never-checked: it is
-    # true only when ≥1 config ran its AUC parity check and none failed it;
-    # parity_checked counts the configs that actually verified.
-    checked = [r for r in results.values() if "parity_ok" in r]
-    payload = {
-        "metric": head.get("metric", f"config{headline_cfg}_failed"),
-        "value": head.get("value", 0.0),
-        "unit": head.get("unit", "s"),
-        "vs_baseline": head.get("vs_baseline", 0.0),
-        "device": head.get("device", "unreachable"),
-        "parity_ok": bool(checked) and all(r["parity_ok"] for r in checked),
-        "parity_checked": len(checked),
-        "degraded_cpu_fallback": degraded,
-        "probe_attempts": len(probe_log),
-        "probe_log": probe_log,
-        "wall_s_total": round(time.perf_counter() - t_start, 1),
-    }
-    if len(results) > 1 or str(args.config or "") not in results:
-        payload["configs"] = results
-    else:
-        payload.update({k: v for k, v in head.items() if k not in payload})
-    errors = {c: r["error"] for c, r in results.items() if "error" in r}
-    if errors:
-        payload["errors"] = errors
-    print(json.dumps(payload), flush=True)
-    ok = "error" not in head and payload["parity_ok"]
-    return 0 if ok else 1
+    signal.alarm(0)
+    return state.emit()
 
 
 def combine(c: int, rows: int, dev: dict, base: dict) -> dict:
@@ -379,7 +509,12 @@ def combine(c: int, rows: int, dev: dict, base: dict) -> dict:
     cpu_s = base["cpu_s"]
     rec["vs_baseline"] = round(cpu_s / rec["value"], 3)
     rec["baseline_wall_s"] = round(cpu_s, 4)
-    for k in ("baseline_measured_rows", "baseline_measured_s"):
+    if rec.get("value_cold_s"):
+        # The warm `value` is the compile-amortized regime; value_cold_s is
+        # one cold start (trace+compile+first fit). Publishing both ratios
+        # keeps every quoted speedup self-qualifying (VERDICT r3 weak #3).
+        rec["vs_baseline_cold"] = round(cpu_s / rec["value_cold_s"], 3)
+    for k in ("baseline_measured_rows", "baseline_measured_s", "baseline_repeats"):
         if k in base:
             rec[k] = base[k]
     if rec["vs_baseline"] < 1.0:
@@ -482,12 +617,32 @@ def device_leg_inference(args) -> dict:
     # Device-only completion is recorded alongside for diagnosis — on a
     # tunneled backend the fetch can dominate, and hiding it would make
     # the latency claim unusable for a real client.
-    e2e_s = _median_time(lambda: float(predict(params, x1)), args.repeats * 10)
+    #
+    # Every timed iteration gets a slightly-jittered patient row: this
+    # backend memoizes repeated identical dispatches, so timing the SAME
+    # row over and over measures the memo table, not a fresh
+    # dispatch+fetch (ADVICE r3 item 3; memory: dispatch memoization).
+    n_timed = args.repeats * 10
+    jrng = np.random.default_rng(2020)
+    probes_np = (
+        x1[None, :, :]
+        + jrng.normal(0, 1e-3, size=(2 * (n_timed + 1), 1, x1.shape[1]))
+    ).astype(np.float32)
+    cursor = {"i": 0}
+
+    def next_probe():
+        i = cursor["i"]
+        cursor["i"] = (i + 1) % probes_np.shape[0]
+        return probes_np[i]
+
+    e2e_s = _median_time(lambda: float(predict(params, next_probe())), n_timed)
     dev_s = _median_time(
-        lambda: jax.block_until_ready(predict(params, x1)), args.repeats * 10
+        lambda: jax.block_until_ready(predict(params, next_probe())), n_timed
     )
     np_params = jax.tree.map(np.asarray, params)
-    cpu_s = _median_time(lambda: _numpy_stacked_predict(np_params, x1), args.repeats * 10)
+    cpu_s = _median_time(
+        lambda: _numpy_stacked_predict(np_params, next_probe()), n_timed
+    )
     prob = float(predict(params, x1))
 
     # Batch regime: the same stacked graph over a cohort-scale matrix.
@@ -500,13 +655,27 @@ def device_leg_inference(args) -> dict:
     rng = np.random.default_rng(2020)
     Xb = (x1 + rng.normal(0, 0.05, size=(nb, x1.shape[1]))).astype(np.float32)
     predict_b = jax.jit(stacking.predict_proba1)
-    Xb_d = jax.device_put(jnp.asarray(Xb))
+    # Distinct device-resident batches per timed repeat — same
+    # anti-memoization rationale as the single-patient loop above.
+    n_batches = args.repeats + 1  # one per timed dispatch + warmup: no
+    #                                   wrap, so no dispatch ever repeats
+    Xb_devs = [
+        jax.device_put(jnp.asarray(Xb + np.float32(1e-4 * i)))
+        for i in range(n_batches)
+    ]
+    bcursor = {"i": 0}
+
+    def next_batch():
+        i = bcursor["i"]
+        bcursor["i"] = (i + 1) % n_batches
+        return Xb_devs[i]
+
     batch_s = _median_time(
-        lambda: float(jnp.sum(predict_b(params, Xb_d))), args.repeats
+        lambda: float(jnp.sum(predict_b(params, next_batch()))), args.repeats
     )
+    Xb64 = Xb.astype(np.float64)  # numpy does not memoize; no jitter needed
     cpu_batch_s = _median_time(
-        lambda: _numpy_stacked_predict(np_params, Xb.astype(np.float64)).sum(),
-        args.repeats,
+        lambda: _numpy_stacked_predict(np_params, Xb64).sum(), args.repeats
     )
 
     rec = {
@@ -644,12 +813,14 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
     with timer.phase("predict_auc") as ph:
         auc = float(ph.block(auc_fn(jnp.asarray(y), predict(holder["params"], X17_d))))
 
+    cold_s = timer.seconds.get("fit_warmup_compile", 0.0)
     rec = {
         "metric": (
             f"single_stump_train_{args.rows}rows" if n_estimators == 1
             else f"gbdt100_train_wall_clock_{args.rows}rows"
         ),
         "value": round(dev_s, 4),
+        "value_cold_s": round(cold_s, 4),
         "unit": "s",
         "auc": auc,
         "splitter": args.splitter,
@@ -657,6 +828,17 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
         "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
         **_utilization(dev_s, args.rows, X17.shape[1], n_estimators),
     }
+    if n_estimators == 1 and cold_s > 5 * dev_s:
+        # Config 2's wall is one-time trace+compile by construction: a
+        # single-stump fit does the same binning as the 100-stump program
+        # but amortizes the compile over 1/100th of the device work.
+        rec["compile_bound"] = True
+        rec["marginal_stage_s"] = round(dev_s, 4)
+        rec["note_compile"] = (
+            "n_estimators=1 at this size is compile-bound: value is the "
+            "amortized warm fit (the marginal cost of a stump once the "
+            "program exists); value_cold_s is trace+compile+first fit"
+        )
 
     if args.trace and n_estimators > 1:
         trace_dir = os.path.join(REPO, args.trace)
@@ -745,11 +927,16 @@ def device_leg_sweep(args) -> dict:
     def ours():
         holder["res"] = sweep_mod.cv_sweep(X17, yf, cfg)
 
-    dev_s = _median_time(ours, args.repeats)
+    # On the CPU fallback a full sweep is tens of seconds; warmup + one
+    # timed run keeps the leg inside its budget clamp (r3: the c4 CPU leg
+    # blew a 72s clamp doing 1+3 sweeps).
+    reps = args.repeats if _is_tpu() else 1
+    dev_s = _median_time(ours, reps)
     res = holder["res"]
     return {
         "metric": f"cv_sweep_3x3_grid_{args.rows}rows",
         "value": round(dev_s, 4),
+        "repeats_used": reps,
         "unit": "s",
         "auc": float(res.best_mean_auc),
         "best_cell": [res.best_max_depth, res.best_n_estimators],
@@ -809,6 +996,7 @@ def device_leg_scaled(args) -> dict:
     return {
         "metric": f"gbdt100_hist_train_{rows}rows_sharded",
         "value": round(dev_s, 4),
+        "value_cold_s": round(timer.seconds.get("fit_warmup_compile", 0.0), 4),
         "unit": "s",
         "auc": auc,
         "train_rows": rows - holdout,
@@ -849,9 +1037,13 @@ def baseline_leg_gbdt(args, n_estimators: int) -> dict:
             n_estimators=n_estimators, max_depth=1, random_state=2020
         ).fit(X17, y)
 
-    cpu_s = _median_time(fit, args.cpu_repeats, warmup=False)
+    # Repeats are for variance at the 1.0x boundary, which only matters for
+    # sub-minute fits; at >=500k rows one 100-stump sklearn fit is 35-80 s
+    # and the 3x median would alone blow the budget slice (r3 post-mortem).
+    reps = args.cpu_repeats if args.rows < 500_000 or n_estimators == 1 else 1
+    cpu_s = _median_time(fit, reps, warmup=False)
     auc = float(metrics.roc_auc(y, holder["m"].predict_proba(X17)[:, 1]))
-    return {"cpu_s": cpu_s, "auc": auc}
+    return {"cpu_s": cpu_s, "auc": auc, "baseline_repeats": reps}
 
 
 def baseline_leg_sweep(args) -> dict:
@@ -868,8 +1060,12 @@ def baseline_leg_sweep(args) -> dict:
             scoring="roc_auc", cv=5,
         ).fit(X17, y)
 
-    cpu_s = _median_time(fit, args.cpu_repeats, warmup=False)
-    return {"cpu_s": cpu_s, "auc": float(holder["gs"].best_score_)}
+    # One run IS 45 fits — internally averaged already; repeating the whole
+    # GridSearchCV three times (135 fits, ~290 s at 50k rows) was the
+    # single most expensive baseline in the r3 budget blowout.
+    cpu_s = _median_time(fit, 1, warmup=False)
+    return {"cpu_s": cpu_s, "auc": float(holder["gs"].best_score_),
+            "baseline_repeats": 1}
 
 
 def baseline_leg_scaled(args) -> dict:

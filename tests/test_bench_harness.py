@@ -1,0 +1,134 @@
+"""Unit tests for the bench.py orchestrator's pure logic.
+
+The orchestrator itself never imports jax (its design contract), so these
+tests import bench.py directly and exercise the probe parser, the
+degraded-row plan, and the signal-flush payload — the pieces whose failure
+modes produced the r1-r3 driver artifacts (VERDICT r3 missing #1/#4).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+class TestProbeParser:
+    def test_tpu_platform_accepted(self):
+        out = "warning: stuff\nPROBE_OK tpu | TPU v5 lite\n"
+        assert bench._parse_probe_output(out) == "tpu | TPU v5 lite"
+
+    def test_axon_platform_accepted(self):
+        assert bench._parse_probe_output("PROBE_OK axon | TPU v5 lite") is not None
+
+    def test_cpu_platform_rejected(self):
+        # VERDICT r3 missing #4: a gracefully-failing plugin yields a
+        # healthy CPU backend — that must read as "TPU down", or the
+        # harness launches the 10M-row config on single-core CPU jax.
+        assert bench._parse_probe_output("PROBE_OK cpu | cpu") is None
+
+    def test_no_probe_line(self):
+        assert bench._parse_probe_output("Traceback ...\nRuntimeError: x") is None
+        assert bench._parse_probe_output("") is None
+        assert bench._parse_probe_output(None) is None
+
+    def test_empty_kind_rejected(self):
+        assert bench._parse_probe_output("PROBE_OK") is None
+        assert bench._parse_probe_output("PROBE_OK   ") is None
+
+
+class TestBudgetPlan:
+    def test_degraded_rows_shrink_c2_c3(self):
+        # r3 post-mortem: 1M-row CPU legs cannot fit the post-probe budget.
+        assert bench.DEGRADED_ROWS[2] <= 200_000
+        assert bench.DEGRADED_ROWS[3] <= 200_000
+
+    def test_degraded_rows_still_exercise_device_binning(self):
+        from machine_learning_replications_tpu.models import gbdt
+
+        assert bench.DEGRADED_ROWS[3] >= gbdt.DEVICE_BINNING_MIN_ROWS
+
+    def test_work_fraction_leaves_emission_margin(self):
+        assert bench.WORK_FRACTION <= 0.9
+        assert bench.PROBE_FRACTION <= 0.5
+
+
+class _Args:
+    config = None
+    rows = None
+    budget = 1800
+
+
+class TestFlushPayload:
+    def test_partial_payload_carries_completed_configs(self):
+        state = bench._RunState(_Args())
+        state.results["3"] = {
+            "metric": "gbdt100_train_wall_clock_200000rows", "value": 1.0,
+            "unit": "s", "vs_baseline": 12.0, "auc": 0.9, "parity_ok": True,
+            "device": "cpu:cpu",
+        }
+        payload = state.build_payload(partial="flushed on signal 15 (SIGTERM)")
+        assert payload["metric"] == "gbdt100_train_wall_clock_200000rows"
+        assert payload["vs_baseline"] == 12.0
+        assert payload["partial"].startswith("flushed on signal")
+        assert payload["parity_ok"] is True
+        json.dumps(payload)  # must be serializable as the one stdout line
+
+    def test_empty_payload_is_still_valid_json_line(self):
+        state = bench._RunState(_Args())
+        payload = state.build_payload(partial="flushed on signal 14 (SIGALRM)")
+        assert payload["metric"] == "config3_failed"
+        assert payload["value"] == 0.0
+        json.dumps(payload)
+
+    def test_emit_is_single_shot(self, capsys):
+        state = bench._RunState(_Args())
+        state.results["3"] = {"metric": "m", "value": 1.0, "unit": "s",
+                              "vs_baseline": 2.0, "parity_ok": True}
+        rc1 = state.emit()
+        rc2 = state.emit()  # second flush (e.g. signal after clean emit): no-op
+        out = capsys.readouterr().out
+        assert len(out.strip().splitlines()) == 1  # exactly one JSON line
+        assert rc1 == 0 and rc2 == 1
+
+
+@pytest.mark.slow
+def test_sigterm_mid_run_flushes_partial_json():
+    """End-to-end: SIGTERM the orchestrator mid-probe and require the
+    stdout JSON line anyway — the exact r3 failure (rc=124, parsed null)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--force-cpu", "--rows", "2000", "--budget", "600"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    import time
+
+    time.sleep(8)  # mid first leg
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=30)
+    line = out.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert "metric" in payload and "vs_baseline" in payload
+    assert payload.get("partial", "").startswith("flushed on signal 15")
