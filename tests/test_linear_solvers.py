@@ -112,3 +112,119 @@ def test_select_top_k_tie_behavior():
     mask = feature_selection.select_top_k(coef, 2)
     # stable argsort: among the three |0.5| ties the *later* indices win
     assert list(np.where(mask)[0]) == [1, 4]
+
+
+def test_lasso_fold_stats_sharded_matches_local(lin_data):
+    """The mesh path's psum'd per-fold Grams equal the static-slice ones
+    (up to float reassociation) — the parity contract of
+    parallel/select_trainer.py on the 8-device CPU mesh."""
+    from machine_learning_replications_tpu.parallel import make_mesh
+    from machine_learning_replications_tpu.parallel.select_trainer import (
+        lasso_fold_stats_sharded,
+    )
+    import jax
+
+    X, y = lin_data
+    local = solvers.lasso_fold_stats(jnp.asarray(X), jnp.asarray(y), 10)
+    mesh = make_mesh()  # 8 virtual CPU devices on 'data'
+    sharded = lasso_fold_stats_sharded(mesh, X, y, 10)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-9, atol=1e-9
+        ),
+        dict(local), dict(sharded),
+    )
+
+
+def test_lasso_cv_sharded_end_to_end_matches_local(lin_data):
+    """fit_select with a mesh reproduces the single-device selection."""
+    from machine_learning_replications_tpu.parallel import make_mesh
+
+    X, y = lin_data
+    cfg = LassoSelectConfig(max_features=6)
+    mask0, info0 = feature_selection.fit_select(X, y, cfg)
+    mask1, info1 = feature_selection.fit_select(X, y, cfg, mesh=make_mesh())
+    np.testing.assert_array_equal(mask0, mask1)
+    np.testing.assert_allclose(info0["coef"], info1["coef"], rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(
+        info0["mse_path"], info1["mse_path"], rtol=1e-6, atol=1e-9
+    )
+
+
+def test_lasso_select_guard_subsample_and_error(lin_data):
+    X, y = lin_data
+    cfg = LassoSelectConfig(max_features=6, max_rows=100, scale_policy="error")
+    with pytest.raises(ValueError, match="max_rows"):
+        feature_selection.fit_select(X, y, cfg)
+
+    cfg = LassoSelectConfig(max_features=6, max_rows=100, scale_policy="subsample")
+    mask, info = feature_selection.fit_select(X, y, cfg)
+    assert info["subsampled_from_rows"] == X.shape[0]
+    assert mask.sum() == 6  # still selects; the guard only caps rows
+
+    # A mesh multiplies the cap by the data-axis size: 8 × 100 < 300 still
+    # subsamples, 8 × 50 likewise; 8 × 100 with n=300 does NOT (300 <= 800).
+    from machine_learning_replications_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    mask2, info2 = feature_selection.fit_select(X, y, cfg, mesh=mesh)
+    assert "subsampled_from_rows" not in info2  # 300 <= 8 * 100
+
+
+def test_lasso_cv_float32_with_large_feature_means():
+    """f32 is the TPU production dtype; raw clinical features have
+    mean/std ratios ~10 (heart rate, lab values). Without the global mean
+    shift in lasso_fold_stats, the covariance-form centering
+    ``sxx − m·x̄x̄ᵀ`` cancels catastrophically at this scale (measured ~8.6
+    relative Gram error at 1M rows) and the selection silently diverges.
+    This pins the f32 path to the f64 reference."""
+    rng = np.random.default_rng(3)
+    n, f = 50_000, 20
+    # mean/std = 100 makes the unshifted cancellation measurable at test
+    # size (3.4e-3 coef error, vs 0.0 shifted — both measured); the atol
+    # below separates them, so removing the shift fails this test.
+    X = (100.0 + rng.normal(size=(n, f))).astype(np.float64)
+    w = np.zeros(f)
+    w[:5] = [2.0, -1.5, 1.0, 0.6, -0.4]
+    y = X @ w + 0.5 * rng.normal(size=n)
+
+    ref = solvers.lasso_cv(jnp.asarray(X), jnp.asarray(y), cv_folds=10)
+    got = solvers.lasso_cv(
+        jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.float32), cv_folds=10
+    )
+    assert got[0].dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(ref[0]), rtol=0, atol=1e-3
+    )
+    np.testing.assert_allclose(float(got[1]), float(ref[1]), rtol=1e-2)
+    mask_ref = feature_selection.select_top_k(np.asarray(ref[0]), 5)
+    mask_got = feature_selection.select_top_k(np.asarray(got[0]), 5)
+    np.testing.assert_array_equal(mask_ref, mask_got)
+
+
+def test_lasso_fold_stats_sharded_f32_matches_f64():
+    """Same f32 guard for the mesh path (it shares the shift)."""
+    from machine_learning_replications_tpu.parallel import make_mesh
+    from machine_learning_replications_tpu.parallel.select_trainer import (
+        lasso_fold_stats_sharded,
+    )
+    import jax
+
+    rng = np.random.default_rng(4)
+    n, f = 20_000, 12
+    X = 10.0 + rng.normal(size=(n, f))
+    y = X[:, 0] - X[:, 1] + rng.normal(size=n)
+    mesh = make_mesh()
+    st64 = solvers.lasso_fold_stats(jnp.asarray(X), jnp.asarray(y), 10)
+    try:
+        jax.config.update("jax_enable_x64", False)
+        st32 = lasso_fold_stats_sharded(mesh, X, y, 10)
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    # Shifted Grams are small numbers; f32 accumulation stays ~1e-4 relative.
+    np.testing.assert_allclose(
+        np.asarray(st32["sxx"]), np.asarray(st64["sxx"]), rtol=5e-3, atol=5e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(st32["mu"]), np.asarray(st64["mu"]), rtol=1e-5
+    )
