@@ -191,6 +191,18 @@ _BLOCKED_BOUNDARY_MIN_N = 16_384
 _BOUNDARY_BLOCK = 512
 
 
+def to_blocks(a: jnp.ndarray, n: int) -> jnp.ndarray:
+    """``[..., n] → [..., nb, _BOUNDARY_BLOCK]`` zero-padded block layout —
+    the single copy of the block arithmetic shared by the blocked stump
+    loops (``models.gbdt._run_stumps``, ``parallel.stump_trainer``) and the
+    flat-input wrapper below. New padding slots hold exact zeros, as
+    ``boundary_sums_3d`` requires."""
+    blk = _BOUNDARY_BLOCK
+    nb = -(-n // blk)
+    widths = [(0, 0)] * (a.ndim - 1) + [(0, nb * blk - n)]
+    return jnp.pad(a, widths).reshape(*a.shape[:-1], nb, blk)
+
+
 def cumulative_boundary_sums(
     v_sorted: jnp.ndarray, left_count: jnp.ndarray
 ) -> jnp.ndarray:
@@ -217,10 +229,7 @@ def cumulative_boundary_sums(
         )
         return jnp.take_along_axis(padded, left_count, axis=1)
 
-    blk = _BOUNDARY_BLOCK
-    nb = -(-n // blk)
-    vp = jnp.pad(v_sorted, ((0, 0), (0, nb * blk - n)))
-    return boundary_sums_3d(vp.reshape(F, nb, blk), left_count)
+    return boundary_sums_3d(to_blocks(v_sorted, n), left_count)
 
 
 def boundary_sums_3d(vb: jnp.ndarray, left_count: jnp.ndarray) -> jnp.ndarray:
@@ -229,11 +238,12 @@ def boundary_sums_3d(vb: jnp.ndarray, left_count: jnp.ndarray) -> jnp.ndarray:
     zeros) + boundary positions ``left_count [F, B-1]`` in ``[0, n]`` →
     ``out[f, b] = Σ vb.flat[f, :left_count[f, b]]``.
 
-    This is the per-stage workhorse of the blocked stump loop: keeping the
-    stage arrays in block shape for the whole ``fori_loop`` avoids the
-    pad+reshape relayout that the flat-input wrapper pays — profiled at
-    ~2.3 ms of a 4.3 ms boosting stage at 1M rows (two reshape kernels +
-    two pads per stage, v5e trace r3)."""
+    This is the per-stage workhorse of the blocked stump loops
+    (``models.gbdt._run_stumps`` and ``parallel.stump_trainer``): both keep
+    their stage arrays in block shape for the whole ``fori_loop`` and call
+    this directly, avoiding the pad+reshape relayout the flat-input wrapper
+    pays — profiled at ~2.3 ms of a 4.3 ms boosting stage at 1M rows (two
+    reshape kernels + two pads per stage, v5e trace r3)."""
     F, nb, blk = vb.shape
     block_sums = jnp.sum(vb, axis=2)                      # [F, nb]
     excl = jnp.cumsum(block_sums, axis=1) - block_sums    # exclusive prefix

@@ -295,3 +295,38 @@ def test_nonbinary_labels_use_gather_fallback(train_data):
     np.testing.assert_allclose(
         np.asarray(sh.value), np.asarray(ref.value), rtol=1e-6, atol=1e-9
     )
+
+
+def test_sharded_blocked_weighted_path_equals_subset(train_data, monkeypatch):
+    """Blocked-regime coverage for the WEIGHTED sharded loop: with block
+    shape, intra-block padding slots are zeroed by ws itself (no explicit
+    row mask), a different branch from the unweighted blocked test above.
+    Must still equal the single-device fit on the physical subset."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from machine_learning_replications_tpu.ops import binning, histogram
+
+    monkeypatch.setattr(histogram, "_BLOCKED_BOUNDARY_MIN_N", 16)
+    monkeypatch.setattr(histogram, "_BOUNDARY_BLOCK", 32)
+    jax.clear_caches()
+    try:
+        X, y = train_data
+        X, y = X[:699], y[:699]  # odd size: intra-block padding on shards
+        w = (np.arange(X.shape[0]) % 4 != 0).astype(float)
+        cfg = GBDTConfig(n_estimators=10, max_depth=1, splitter="hist")
+        bins = binning.bin_features(X, 256)
+        mesh = make_mesh(data=4, model=2)
+        sh, _ = stump_trainer.fit(mesh, X, y, cfg, bins=bins, sample_weight=w)
+        sub_bins = binning.BinnedFeatures(
+            binned=bins.binned[w > 0], thresholds=bins.thresholds,
+            n_bins=bins.n_bins,
+        )
+        ref, _ = gbdt.fit(X[w > 0], y[w > 0], cfg, bins=sub_bins)
+        np.testing.assert_array_equal(
+            np.asarray(sh.feature), np.asarray(ref.feature)
+        )
+        np.testing.assert_allclose(
+            np.asarray(sh.value), np.asarray(ref.value), rtol=1e-6, atol=1e-9
+        )
+    finally:
+        jax.clear_caches()

@@ -282,3 +282,61 @@ def test_chunked_row_reduce_rejects_empty():
         binning.chunked_row_reduce(
             np.empty((0, 4), np.float32), lambda c: c.sum(0)
         )
+
+
+def test_block_shape_stage_loop_matches_flat(monkeypatch):
+    """The blocked-regime stage loop keeps its arrays in [F, nb, blk]
+    block shape for the whole fori_loop (no per-stage pad+reshape). The
+    resulting forest must match the flat sequential loop's on the same
+    data — same splits/thresholds exactly, leaf values and deviance to
+    float tolerance (blocked summation regroups), and the sklearn AUC
+    parity budget must hold at this size."""
+    import jax
+
+    from machine_learning_replications_tpu.ops import histogram
+    from machine_learning_replications_tpu.utils import metrics
+
+    rng = np.random.default_rng(21)
+    n = histogram._BLOCKED_BOUNDARY_MIN_N + 4321  # odd: exercises padding
+    X = rng.normal(size=(n, 5)).astype(np.float64)
+    logits = 1.2 * X[:, 0] - 0.8 * X[:, 2] + 0.3 * rng.normal(size=n)
+    y = (logits > 0).astype(np.float64)
+    cfg = GBDTConfig(splitter="hist", n_estimators=12)
+
+    params_b, aux_b = gbdt.fit(X, y, cfg)
+
+    # Force the flat sequential loop by raising the threshold past n. The
+    # blocked/flat branch is a TRACE-time decision inside a jitted function
+    # whose cache keys on shapes only, so the caches must be flushed or the
+    # second fit would silently rerun the blocked executable and the
+    # comparison would be vacuous (and flushed again in finally so no
+    # flat-path executable leaks into later blocked-regime tests).
+    monkeypatch.setattr(histogram, "_BLOCKED_BOUNDARY_MIN_N", n + 10_000)
+    jax.clear_caches()
+    try:
+        params_f, aux_f = gbdt.fit(X, y, cfg)
+    finally:
+        monkeypatch.undo()
+        jax.clear_caches()
+
+    np.testing.assert_array_equal(
+        np.asarray(params_b.feature), np.asarray(params_f.feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(params_b.threshold), np.asarray(params_f.threshold)
+    )
+    np.testing.assert_allclose(
+        np.asarray(params_b.value), np.asarray(params_f.value),
+        rtol=1e-5, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(aux_b["train_deviance"]), np.asarray(aux_f["train_deviance"]),
+        rtol=1e-5, atol=1e-7,
+    )
+    p_b = np.asarray(tree.predict_proba1(params_b, X))
+    auc = float(metrics.roc_auc(y, p_b))
+    sk = GradientBoostingClassifier(
+        n_estimators=12, max_depth=1, random_state=2020
+    ).fit(X, y)
+    auc_sk = float(metrics.roc_auc(y, sk.predict_proba(X)[:, 1]))
+    assert abs(auc - auc_sk) <= 0.005
