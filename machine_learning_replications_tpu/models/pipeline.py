@@ -316,7 +316,26 @@ def cross_val_member_probas(
         # would dominate); everywhere else — including every parity-test
         # size — host unique-value bins keep the mesh path's candidates
         # identical to fit_folds', so meta-features match bit-for-bit.
-        if (
+        if cfg.gbdt.per_fold_binning:
+            # Reference-exact protocol under the mesh too: host-bin each
+            # fold's own rows, re-bin all rows against those thresholds
+            # (excluded rows carry weight 0 — parked). Threshold widths
+            # differ per fold, so each fold may compile its own program.
+            budget = gbdt.bin_budget_capped(cfg.gbdt)
+            X_np = np.asarray(X)
+
+            def fold_bins_for(j):
+                bf = binning.bin_features(
+                    X_np[np.asarray(train_masks_np[j]) > 0], budget
+                )
+                return binning.BinnedFeatures(
+                    binned=binning.rebin_with_thresholds(
+                        X_np, bf.thresholds, bf.n_bins
+                    ),
+                    thresholds=bf.thresholds,
+                    n_bins=bf.n_bins,
+                )
+        elif (
             cfg.gbdt.splitter == "hist"
             and X.shape[0] >= gbdt.DEVICE_BINNING_MIN_ROWS
         ):
@@ -326,9 +345,10 @@ def cross_val_member_probas(
         else:
             fold_bins = binning.bin_features(X, gbdt.bin_budget_capped(cfg.gbdt))
         probas = []
-        for j in range(k):  # one compiled program, k reuses
+        for j in range(k):  # one compiled program, k reuses (shared bins)
             gp_j, _ = fit_gbdt_sharded(
-                mesh, X, y, cfg.gbdt, bins=fold_bins,
+                mesh, X, y, cfg.gbdt,
+                bins=fold_bins_for(j) if cfg.gbdt.per_fold_binning else fold_bins,
                 sample_weight=train_masks_np[j],
             )
             probas.append(tree.predict_proba1(gp_j, Xj))

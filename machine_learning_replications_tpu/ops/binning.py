@@ -82,6 +82,35 @@ def bin_features(X: np.ndarray, n_bins: int | None = 256) -> BinnedFeatures:
     return BinnedFeatures(binned=binned, thresholds=thresholds, n_bins=counts)
 
 
+def rebin_with_thresholds(
+    X: np.ndarray, thresholds: np.ndarray, n_bins: np.ndarray | None = None
+) -> np.ndarray:
+    """Bin ALL rows of ``X`` against an existing threshold table: bin id =
+    number of real thresholds strictly below the value (the exact
+    convention ``bin_features`` uses, so rows that were in the table's fit
+    set reproduce their original ids bit-for-bit). Rows outside the fit
+    set land in the nearest edge bin — the per-fold-binning path uses this
+    to give every (masked) row an id under each fold's own candidates.
+
+    ``n_bins`` (per-feature real bin counts) selects the real boundaries as
+    ``thresholds[f, :n_bins[f]-1]`` — required for exactness when a
+    feature's data contains ±inf (a −inf midpoint is a REAL boundary that
+    an isfinite filter would drop, shifting every id down by one). Without
+    it, boundaries are taken as the finite entries (valid whenever the
+    data itself is finite, since the pad value is +inf).
+    """
+    n, F = X.shape
+    out = np.zeros((n, F), np.int32)
+    for f in range(F):
+        thr = thresholds[f]
+        if n_bins is not None:
+            thr = thr[: int(n_bins[f]) - 1]
+        else:
+            thr = thr[np.isfinite(thr)]
+        out[:, f] = np.searchsorted(thr, X[:, f], side="left")
+    return out
+
+
 def feature_bin_counts(bins: BinnedFeatures) -> tuple[int, ...]:
     """Static per-feature bin counts — the matmul histogram backend's
     traffic lever (it sizes each feature's one-hot to its real bin range)."""

@@ -330,3 +330,26 @@ def test_sharded_blocked_weighted_path_equals_subset(train_data, monkeypatch):
         )
     finally:
         jax.clear_caches()
+
+
+def test_mesh_cross_val_per_fold_binning_matches_single_device(train_data):
+    """cfg.gbdt.per_fold_binning must be honored by the mesh fold loop too:
+    mesh and single-device runs of the identical per-fold-binning config
+    must produce the same GBDT meta-feature column."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from machine_learning_replications_tpu.config import ExperimentConfig, SVCConfig
+    from machine_learning_replications_tpu.models import pipeline
+
+    X, y = train_data
+    Xs, ys = X[:300], y[:300]
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=8, per_fold_binning=True),
+        svc=SVCConfig(platt_cv=2, max_iter=300),
+    )
+    mesh = make_mesh(data=4, model=2)
+    meta_mesh = pipeline.cross_val_member_probas(Xs, ys, cfg, mesh=mesh)
+    meta_single = pipeline.cross_val_member_probas(Xs, ys, cfg)
+    np.testing.assert_allclose(
+        meta_mesh[:, 1], meta_single[:, 1], rtol=1e-7, atol=1e-9
+    )

@@ -340,3 +340,57 @@ def test_block_shape_stage_loop_matches_flat(monkeypatch):
     ).fit(X, y)
     auc_sk = float(metrics.roc_auc(y, sk.predict_proba(X)[:, 1]))
     assert abs(auc - auc_sk) <= 0.005
+
+
+def test_per_fold_binning_matches_subset_fits():
+    """cfg.per_fold_binning=True closes the documented candidate-set
+    deviation: each fold's candidates come from its OWN rows, so every
+    fold's forest must equal a standalone fit on the physical subset
+    (which bins its own input — sklearn's per-refit protocol)."""
+    from machine_learning_replications_tpu.ops import binning
+
+    rng = np.random.default_rng(5)
+    n, f, k = 600, 6, 3
+    X = rng.normal(size=(n, f))  # continuous: per-fold candidates DIFFER
+    w = rng.normal(size=f)
+    y = (X @ w + 0.5 * rng.normal(size=n) > 0.2).astype(float)
+    masks = np.ones((k, n))
+    for i in range(k):  # contiguous held-out blocks
+        masks[i, i * (n // k):(i + 1) * (n // k)] = 0.0
+
+    # rebin_with_thresholds must reproduce bin_features' ids on the fit set.
+    bf = binning.bin_features(X[masks[0] > 0], 256)
+    np.testing.assert_array_equal(
+        binning.rebin_with_thresholds(X[masks[0] > 0], bf.thresholds),
+        bf.binned,
+    )
+
+    cfg = GBDTConfig(
+        splitter="hist", n_estimators=8, max_depth=2, per_fold_binning=True
+    )
+    batched = gbdt.fit_folds(X, y, masks, cfg)
+    for i in range(k):
+        sub = masks[i] > 0
+        ref, _ = gbdt.fit(X[sub], y[sub], GBDTConfig(
+            splitter="hist", n_estimators=8, max_depth=2
+        ))
+        np.testing.assert_array_equal(
+            np.asarray(batched.feature[i]), np.asarray(ref.feature),
+            err_msg=f"fold {i} split features",
+        )
+        np.testing.assert_allclose(
+            np.asarray(batched.threshold[i]), np.asarray(ref.threshold),
+            rtol=1e-12, err_msg=f"fold {i} thresholds",
+        )
+        np.testing.assert_allclose(
+            np.asarray(batched.value[i]), np.asarray(ref.value),
+            rtol=1e-9, atol=1e-12, err_msg=f"fold {i} leaf values",
+        )
+        np.testing.assert_allclose(
+            float(batched.init_raw[i]), float(ref.init_raw), rtol=1e-12
+        )
+
+
+def test_per_fold_binning_defaults_to_shared_bins():
+    """The flag is off by default and the shared-bins path is unchanged."""
+    assert GBDTConfig().per_fold_binning is False
