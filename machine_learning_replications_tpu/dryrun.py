@@ -213,6 +213,22 @@ def run(n_devices: int) -> None:
     _say(f"phase 6 done: sharded imputer transform + mesh CV fold fits == "
          f"single-device ({time.time() - t:.1f}s)")
 
+    # Phase 7 — sharded feature selection: the covariance-form LassoCV's
+    # per-fold Gram statistics psum'd over 'data'
+    # (parallel.select_trainer), against the static-slice single-device
+    # stats; the full selection (top-17 mask) must agree exactly.
+    t = time.time()
+    from machine_learning_replications_tpu.config import LassoSelectConfig
+    from machine_learning_replications_tpu.models import feature_selection
+
+    sel_cfg = LassoSelectConfig()
+    mask_sh, _ = feature_selection.fit_select(imp_sd, ym, sel_cfg, mesh=mesh)
+    mask_sd, _ = feature_selection.fit_select(imp_sd, ym, sel_cfg)
+    np.testing.assert_array_equal(mask_sh, mask_sd)
+    assert int(mask_sh.sum()) == sel_cfg.max_features
+    _say(f"phase 7 done: sharded lasso fold-Gram selection == single-device "
+         f"({time.time() - t:.1f}s)")
+
     _say(f"dryrun_multichip OK in {time.time() - t_all:.1f}s: mesh "
          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, all phases "
          "parity-checked")

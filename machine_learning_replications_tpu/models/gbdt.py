@@ -441,59 +441,34 @@ def _run_stumps(
     min_samples_leaf: int,
 ):
     """Run boosting stages ``[start, stop)`` on the replicated sorted layout:
-    each stage is a handful of dense [F, n] passes — expit, blocked boundary
-    sums, static lookups, one compare — with no dynamic gather/scatter
-    anywhere (TPU serializes those onto the scalar unit). ``start``/``stop``
-    are dynamic so checkpoint-resume chunks share one compilation.
+    each stage is a handful of dense [F, n] passes — expit, boundary sums
+    (blocked decomposition above 16k rows, inside the wrapper), static
+    lookups, one compare — with no dynamic gather/scatter anywhere (TPU
+    serializes those onto the scalar unit). ``start``/``stop`` are dynamic
+    so checkpoint-resume chunks share one compilation.
 
-    At blocked-regime sizes (n ≥ ops.histogram._BLOCKED_BOUNDARY_MIN_N) the
-    stage arrays are converted ONCE per call to ``[F, nb, blk]`` block shape
-    and stay there for the whole ``fori_loop`` — the flat wrapper's
-    per-stage pad+reshape relayout was ~2.3 ms of a 4.3 ms boosting stage
-    at 1M rows (two reshape kernels + two pads per stage, v5e trace r3;
-    ADVICE r3 item 1). The carry crosses this function flat, so the
-    checkpoint-resume format is unchanged. Below the threshold the flat
-    sequential path runs unchanged (bitwise-stable parity regimes).
+    Deliberately FLAT loop carry: keeping the stage arrays block-resident
+    (``[F, nb, blk]`` for the whole ``fori_loop``, per-stage pad+reshape
+    hoisted out) was ablated on v5e in r3 and re-confirmed neutral on CPU
+    in r4 — zero runtime gain (XLA fuses the relayout into the stage's
+    elementwise chain) and an O(n) compile blowup when a large pad+reshape
+    feeds a while loop (~60 s at 600k rows; docs/SCALING.md "Lowerings",
+    memory note tpu-stump-loop-floor). Do not re-introduce it.
     """
     F, n = sd.y_sorted.shape
     dtype = sd.thresholds.dtype
     CL = sd.left_count.astype(dtype)[None]        # [1, F, B-1] — static counts
     CT = jnp.asarray([n], dtype)
-
-    blocked = n >= histogram._BLOCKED_BOUNDARY_MIN_N
-    if blocked:
-        def to_blocks(a):
-            return histogram.to_blocks(a, n)
-
-        ys = to_blocks(sd.y_sorted.astype(dtype))      # [F, nb, blk]
-        bx = to_blocks(sd.bins_x)                      # [F, F, nb, blk]
-        # Real-slot mask: padding slots carry raw ≠ ±inf, so g/h must be
-        # zeroed explicitly each stage (XLA fuses the multiply into the
-        # same elementwise kernel that forms g/h — no extra pass).
-        mask = to_blocks(jnp.ones((1, n), dtype))      # [1, nb, blk]
-        boundary_sums = functools.partial(
-            histogram.boundary_sums_3d, left_count=sd.left_count
-        )
-        raw0, *forest0 = carry
-        carry = (to_blocks(raw0), *forest0)
-    else:
-        ys = sd.y_sorted.astype(dtype)                 # [F, n]
-        bx = sd.bins_x
-        mask = None
-        boundary_sums = functools.partial(
-            histogram.cumulative_boundary_sums, left_count=sd.left_count
-        )
+    ys = sd.y_sorted.astype(dtype)                # [F, n]
+    bx = sd.bins_x
 
     def stage(t, carry):
-        raw, feats, thrs, vals, splits, devs = carry   # raw: [F, …] replicated
+        raw, feats, thrs, vals, splits, devs = carry   # raw: [F, n] replicated
         p = jax.scipy.special.expit(raw)
-        g = ys - p
+        g = ys - p                                      # [F, n]
         h = p * (1.0 - p)
-        if mask is not None:
-            g = g * mask
-            h = h * mask
-        GL = boundary_sums(g)[None]
-        HL = boundary_sums(h)[None]
+        GL = histogram.cumulative_boundary_sums(g, sd.left_count)[None]
+        HL = histogram.cumulative_boundary_sums(h, sd.left_count)[None]
         GT = jnp.sum(g[0])
         HT = jnp.sum(h[0])
         sp = histogram.select_splits(
@@ -517,10 +492,7 @@ def _run_stumps(
         go_left = split_bins <= bstar.astype(split_bins.dtype)
         contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
         raw = raw + learning_rate * contrib
-        ll_terms = ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])
-        if mask is not None:
-            ll_terms = ll_terms * mask[0]
-        dev = -2.0 * jnp.sum(ll_terms) / n
+        dev = -2.0 * jnp.mean(ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0]))
 
         feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)
         thr_t = jnp.stack([jnp.where(do, sp.threshold[0], jnp.inf),
@@ -537,11 +509,7 @@ def _run_stumps(
             devs.at[t].set(dev),
         )
 
-    out = jax.lax.fori_loop(start, stop, stage, carry)
-    if blocked:
-        raw_b, *forest = out
-        out = (raw_b.reshape(F, -1)[:, :n], *forest)
-    return out
+    return jax.lax.fori_loop(start, stop, stage, carry)
 
 
 def fit_folds(

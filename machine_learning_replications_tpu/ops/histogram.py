@@ -193,9 +193,10 @@ _BOUNDARY_BLOCK = 512
 
 def to_blocks(a: jnp.ndarray, n: int) -> jnp.ndarray:
     """``[..., n] → [..., nb, _BOUNDARY_BLOCK]`` zero-padded block layout —
-    the single copy of the block arithmetic shared by the blocked stump
-    loops (``models.gbdt._run_stumps``, ``parallel.stump_trainer``) and the
-    flat-input wrapper below. New padding slots hold exact zeros, as
+    the single copy of the block arithmetic, used by the flat-input
+    ``cumulative_boundary_sums`` wrapper (its only caller; see
+    ``boundary_sums_3d``'s docstring for why the stump loops deliberately
+    do NOT call this themselves). New padding slots hold exact zeros, as
     ``boundary_sums_3d`` requires."""
     blk = _BOUNDARY_BLOCK
     nb = -(-n // blk)
@@ -238,12 +239,12 @@ def boundary_sums_3d(vb: jnp.ndarray, left_count: jnp.ndarray) -> jnp.ndarray:
     zeros) + boundary positions ``left_count [F, B-1]`` in ``[0, n]`` →
     ``out[f, b] = Σ vb.flat[f, :left_count[f, b]]``.
 
-    This is the per-stage workhorse of the blocked stump loops
-    (``models.gbdt._run_stumps`` and ``parallel.stump_trainer``): both keep
-    their stage arrays in block shape for the whole ``fori_loop`` and call
-    this directly, avoiding the pad+reshape relayout the flat-input wrapper
-    pays — profiled at ~2.3 ms of a 4.3 ms boosting stage at 1M rows (two
-    reshape kernels + two pads per stage, v5e trace r3)."""
+    Reached through the flat-input wrapper above, whose pad+reshape XLA
+    fuses into the surrounding stage at no measured runtime cost. Keeping
+    the stump loops' stage arrays block-resident to call this directly was
+    ablated on v5e (r3, re-confirmed neutral on CPU r4): zero runtime gain
+    and an O(n) compile blowup when a large pad+reshape feeds a while loop
+    — see docs/SCALING.md "Lowerings" before moving the block conversion."""
     F, nb, blk = vb.shape
     block_sums = jnp.sum(vb, axis=2)                      # [F, nb]
     excl = jnp.cumsum(block_sums, axis=1) - block_sums    # exclusive prefix

@@ -298,35 +298,13 @@ def _fit_sharded(
         )
         lc = jnp.sum(lc_mapped, axis=0).T.astype(jnp.int32)   # [F_loc, B-1]
         F_loc = F_loc_s
-
-        # ---- block-shape stage arrays (ADVICE r3 item 1) ---------------
-        # Above the blocked-boundary threshold, convert the per-stage
-        # arrays to [.., nb, blk] ONCE so boundary_sums_3d runs directly —
-        # the flat wrapper's per-stage pad+reshape relayout was ~2.3 ms of
-        # a 4.3 ms stage at 1M rows (v5e trace r3). Newly created
-        # intra-block padding slots must contribute exact zeros: ws (which
-        # pads with 0) covers them in the weighted case; the unweighted
-        # case gets an explicit real-slot mask.
+        # NOTE: the stage loop below deliberately keeps a FLAT [F_loc,
+        # n_local] carry and pays cumulative_boundary_sums' internal
+        # pad+reshape per stage — the block-resident alternative was
+        # ablated on v5e in r3: zero runtime gain and an O(n) compile
+        # blowup when a large pad+reshape feeds a while loop
+        # (docs/SCALING.md "Lowerings"; memory note tpu-stump-loop-floor).
         from machine_learning_replications_tpu.ops import histogram as hist_ops
-
-        use_blocks = n_local >= hist_ops._BLOCKED_BOUNDARY_MIN_N
-        if use_blocks:
-            def to_blocks(a):
-                return hist_ops.to_blocks(a, n_local)
-
-            ys = to_blocks(ys)
-            bx = to_blocks(bx)
-            if weighted:
-                ws = to_blocks(ws)
-                row_mask = None  # ws is already 0 on every padding slot
-            else:
-                row_mask = to_blocks(jnp.ones((1, n_local), dtype))
-            row_shape = ys.shape[-2:]
-            boundary_local = hist_ops.boundary_sums_3d
-        else:
-            row_mask = None
-            row_shape = (n_local,)
-            boundary_local = hist_ops.cumulative_boundary_sums
 
         def gsum(v):
             """Global Σ over real rows of a per-row [n_local] quantity, taken
@@ -341,15 +319,13 @@ def _fit_sharded(
             n_real = gsum(ws[0])  # rows are real ⇔ w=1
             sum_y = gsum(ys[0] * ws[0])
         else:
-            # row_mask (blocked regime) excludes intra-block padding slots.
-            n_real = gsum(row_mask[0] if row_mask is not None
-                          else jnp.ones_like(ys[0]))
+            n_real = gsum(jnp.ones_like(ys[0]))
             sum_y = gsum(ys[0])
         p1 = sum_y / n_real
         f0 = jnp.log(p1 / (1.0 - p1))
 
-        def cumb(v):  # per-row values → global left-of-boundary sums [F_loc, B-1]
-            return jax.lax.psum(boundary_local(v, lc), DATA_AXIS)
+        def cumb(v):  # [F_loc, n_local] → global left-of-boundary sums [F_loc, B-1]
+            return jax.lax.psum(hist_ops.cumulative_boundary_sums(v, lc), DATA_AXIS)
 
         if weighted:
             CL = cumb(ws)  # weights don't change: hoisted out of the loop
@@ -363,9 +339,6 @@ def _fit_sharded(
             if weighted:
                 g = (ys - p) * ws
                 h = p * (1.0 - p) * ws
-            elif row_mask is not None:
-                g = (ys - p) * row_mask
-                h = p * (1.0 - p) * row_mask
             else:
                 g = ys - p
                 h = p * (1.0 - p)
@@ -432,11 +405,7 @@ def _fit_sharded(
             raw = raw + learning_rate * contrib
 
             ll_terms = ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])
-            if weighted:
-                ll_terms = ll_terms * ws[0]
-            elif row_mask is not None:
-                ll_terms = ll_terms * row_mask[0]
-            ll = gsum(ll_terms)
+            ll = gsum(ll_terms * ws[0] if weighted else ll_terms)
             dev = -2.0 * ll / n_real
 
             feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)
@@ -459,7 +428,7 @@ def _fit_sharded(
             )
 
         init = (
-            jnp.full((F_loc, *row_shape), f0, dtype),
+            jnp.full((F_loc, n_local), f0, dtype),
             jnp.zeros((n_stages, 3), jnp.int32),
             jnp.full((n_stages, 3), jnp.inf, dtype),
             jnp.zeros((n_stages, 3), dtype),

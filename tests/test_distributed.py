@@ -298,10 +298,10 @@ def test_nonbinary_labels_use_gather_fallback(train_data):
 
 
 def test_sharded_blocked_weighted_path_equals_subset(train_data, monkeypatch):
-    """Blocked-regime coverage for the WEIGHTED sharded loop: with block
-    shape, intra-block padding slots are zeroed by ws itself (no explicit
-    row mask), a different branch from the unweighted blocked test above.
-    Must still equal the single-device fit on the physical subset."""
+    """Blocked-regime coverage for the WEIGHTED sharded loop (the
+    unweighted blocked test above leaves the per-stage weighted sums — CL
+    hoisting, zero-weight padding rows — unexercised). Must still equal
+    the single-device fit on the physical subset."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     from machine_learning_replications_tpu.ops import binning, histogram

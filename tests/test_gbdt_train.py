@@ -285,12 +285,12 @@ def test_chunked_row_reduce_rejects_empty():
 
 
 def test_block_shape_stage_loop_matches_flat(monkeypatch):
-    """The blocked-regime stage loop keeps its arrays in [F, nb, blk]
-    block shape for the whole fori_loop (no per-stage pad+reshape). The
-    resulting forest must match the flat sequential loop's on the same
-    data — same splits/thresholds exactly, leaf values and deviance to
-    float tolerance (blocked summation regroups), and the sklearn AUC
-    parity budget must hold at this size."""
+    """Above _BLOCKED_BOUNDARY_MIN_N the stage loop's boundary sums use the
+    blocked decomposition (inside cumulative_boundary_sums). A full fit in
+    that regime must match one forced onto the flat sequential path — same
+    splits/thresholds exactly, leaf values and deviance to float tolerance
+    (blocked summation regroups), and the sklearn AUC parity budget must
+    hold at this size."""
     import jax
 
     from machine_learning_replications_tpu.ops import histogram
