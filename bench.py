@@ -623,17 +623,26 @@ def device_leg_inference(args) -> dict:
     # row over and over measures the memo table, not a fresh
     # dispatch+fetch (ADVICE r3 item 3; memory: dispatch memoization).
     n_timed = args.repeats * 10
+
+    def make_cycler(arr):
+        """Cycle host-side (numpy) inputs so every timed call is a fresh
+        dispatch — device-resident pools would add an eager index dispatch
+        to each repeat on this backend."""
+        cur = {"i": 0}
+
+        def nxt():
+            i = cur["i"]
+            cur["i"] = (i + 1) % arr.shape[0]
+            return arr[i]
+
+        return nxt
+
     jrng = np.random.default_rng(2020)
     probes_np = (
         x1[None, :, :]
         + jrng.normal(0, 1e-3, size=(2 * (n_timed + 1), 1, x1.shape[1]))
     ).astype(np.float32)
-    cursor = {"i": 0}
-
-    def next_probe():
-        i = cursor["i"]
-        cursor["i"] = (i + 1) % probes_np.shape[0]
-        return probes_np[i]
+    next_probe = make_cycler(probes_np)
 
     e2e_s = _median_time(lambda: float(predict(params, next_probe())), n_timed)
     dev_s = _median_time(
@@ -645,12 +654,24 @@ def device_leg_inference(args) -> dict:
     )
     prob = float(predict(params, x1))
 
+    # Pure link round trip: the smallest possible send+dispatch+fetch (one
+    # scalar through a jitted add), host-jittered per repeat like the
+    # patient rows. Same timing basis as e2e_s (host in -> host out), so
+    # e2e minus this estimates what a colocated client would see
+    # (VERDICT r3 weak #4 — makes the honest sub-1x number
+    # self-explaining in the artifact).
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda v: v + 1.0)
+    next_scalar = make_cycler(
+        np.arange(2 * (n_timed + 1), dtype=np.float32)
+    )
+    rtt_s = _median_time(lambda: float(tiny(next_scalar())), n_timed)
+
     # Batch regime: the same stacked graph over a cohort-scale matrix.
     # Single-patient offload is round-trip-bound by construction (a
     # 17-feature closed form cannot amortize any link), so the artifact
     # carries the throughput point where a device makes sense at all.
-    import jax.numpy as jnp
-
     nb = 100_000
     rng = np.random.default_rng(2020)
     Xb = (x1 + rng.normal(0, 0.05, size=(nb, x1.shape[1]))).astype(np.float32)
@@ -685,6 +706,8 @@ def device_leg_inference(args) -> dict:
         "vs_baseline": round(cpu_s / e2e_s, 3),
         "baseline_ms": round(cpu_s * 1e3, 4),
         "device_only_ms": round(dev_s * 1e3, 4),
+        "link_rtt_ms": round(rtt_s * 1e3, 4),
+        "latency_colocated_est_ms": round(max(e2e_s - rtt_s, 0.0) * 1e3, 4),
         "probability_pct": round(100 * prob, 2),
         "batch100k_rows_per_s": round(nb / batch_s, 1),
         "batch100k_vs_numpy": round(cpu_batch_s / batch_s, 3),

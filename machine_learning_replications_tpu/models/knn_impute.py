@@ -104,8 +104,25 @@ def transform(
     With ``mesh``, query rows are sharded over the 'data' axis — the
     imputation of a row depends only on the (replicated) donor matrix, so
     the transform is embarrassingly row-parallel (VERDICT r2 item 5: at 10M
-    rows this was the next single-device wall after the GBDT member)."""
+    rows this was the next single-device wall after the GBDT member).
+
+    Complete rows (no NaN) are imputation fixed points, so only the
+    incomplete rows travel through the O(rows × donors) distance machinery
+    — at the cohort's ~3% row missingness that is ~30× less imputer work,
+    with bit-identical output (sklearn's KNNImputer computes distances
+    only for receivers too)."""
     chunk = ImputerConfig().chunk_rows if chunk_rows is None else chunk_rows
+    X_np = np.asarray(X)
+    incomplete = np.isnan(X_np).any(axis=1)
+    n_inc = int(incomplete.sum())
+    if n_inc == 0:
+        return jnp.asarray(X_np)
+    if n_inc < X_np.shape[0]:
+        out = np.array(X_np, dtype=X_np.dtype)
+        out[incomplete] = np.asarray(
+            transform(params, X_np[incomplete], chunk_rows, mesh=mesh)
+        )
+        return jnp.asarray(out)
     if mesh is not None:
         from machine_learning_replications_tpu.parallel.rowwise import (
             apply_rows_sharded,
@@ -118,7 +135,6 @@ def transform(
     n = int(X.shape[0])
     if n <= chunk:
         return _transform_block(params, X)
-    X_np = np.asarray(X)
     blocks = []
     for s in range(0, n, chunk):
         block = X_np[s : s + chunk]

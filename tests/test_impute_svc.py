@@ -118,3 +118,33 @@ def test_trim_support(svc_data):
         rtol=1e-9,
         atol=1e-9,
     )
+
+
+def test_transform_complete_rows_pass_through_unchanged():
+    """The incomplete-row pre-filter must be semantically invisible: mixed
+    cohorts impute identically to the all-rows path, complete rows are
+    returned bit-for-bit, and an all-complete cohort short-circuits."""
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.models import knn_impute
+
+    rng = np.random.default_rng(17)
+    Xf = rng.normal(size=(120, 6))
+    params = knn_impute.fit(jnp.asarray(Xf))
+
+    Xq = rng.normal(size=(40, 6))
+    Xq[5, 2] = np.nan
+    Xq[17, 0] = np.nan
+    out = np.asarray(knn_impute.transform(params, jnp.asarray(Xq)))
+    # complete rows bit-identical
+    complete = ~np.isnan(Xq).any(axis=1)
+    np.testing.assert_array_equal(out[complete], Xq[complete])
+    # incomplete rows match imputing them alone (the pre-filter's route)
+    alone = np.asarray(knn_impute.transform(params, jnp.asarray(Xq[~complete])))
+    np.testing.assert_array_equal(out[~complete], alone)
+    assert np.isfinite(out).all()
+    # all-complete short-circuit
+    np.testing.assert_array_equal(
+        np.asarray(knn_impute.transform(params, jnp.asarray(Xq[complete]))),
+        Xq[complete],
+    )
