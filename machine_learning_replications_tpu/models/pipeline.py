@@ -56,10 +56,26 @@ class PipelineParams:
 
 
 class _NullStages:
-    """Stage runner used when no checkpoint dir is given: straight through."""
+    """Stage runner used when no checkpoint dir is given: straight through,
+    with per-stage wall-clock progress on stderr (``utils.trace.stage_say``
+    — see its docstring for the rationale and the opt-out)."""
 
     def run(self, name: str, compute):
-        return compute()
+        import time
+
+        import jax
+
+        from machine_learning_replications_tpu.utils.trace import stage_say
+
+        t0 = time.time()
+        stage_say(f"stage {name!r} ...")
+        # Block on device completion before stopping the clock: jitted
+        # stage outputs dispatch asynchronously, and unblocked timing
+        # would attribute a stage's device work to the NEXT stage's first
+        # data-dependent op — the opposite of what this line is for.
+        out = jax.block_until_ready(compute())
+        stage_say(f"stage {name!r} done in {time.time() - t0:.1f}s")
+        return out
 
 
 # Memory budget for running SVC fold fits as vmapped lanes: each lane

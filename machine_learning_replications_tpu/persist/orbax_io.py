@@ -266,15 +266,30 @@ class StageCheckpointer:
         should a corrupt checkpoint nonetheless surface (e.g. torn tensorstore
         files from a crash mid-``save_params``), the stage falls back to
         recomputing rather than wedging the resume."""
+        import time
+
+        import jax
+
+        from machine_learning_replications_tpu.utils.trace import stage_say
+
         if self.completed(name):
             try:
-                return load_model(self._path(name))
-            except Exception:
+                out = load_model(self._path(name))
+                stage_say(f"stage {name!r} restored from checkpoint")
+                return out
+            except Exception as e:
                 import shutil
 
                 shutil.rmtree(self._path(name), ignore_errors=True)
-        out = compute()
+                stage_say(
+                    f"stage {name!r}: checkpoint corrupt "
+                    f"({type(e).__name__}) — discarded, recomputing"
+                )
+        stage_say(f"stage {name!r} ...")
+        t0 = time.time()
+        out = jax.block_until_ready(compute())
         save_model(self._path(name), out)
+        stage_say(f"stage {name!r} done in {time.time() - t0:.1f}s (checkpointed)")
         if self._interrupt_after == name:
             raise SimulatedInterrupt(f"after stage {name!r}")
         return out

@@ -81,6 +81,22 @@ class PhaseTimer:
         return "\n".join(lines)
 
 
+def stage_say(msg: str) -> None:
+    """One timestamped stderr progress line, shared by both pipeline stage
+    runners (checkpointed and straight-through) so their output stays
+    grep-identical. A multi-hour scaled fit with six silent stages is
+    undiagnosable from outside (r4 lesson: a 4M single-core run gave no
+    signal of which stage it was in for hours). Opt out with
+    ``MLR_TPU_PROGRESS=0`` (e.g. fits inside tight candidate loops)."""
+    import os
+    import sys
+
+    if os.environ.get("MLR_TPU_PROGRESS", "1") == "0":
+        return
+    print(f"[pipeline {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
 @contextlib.contextmanager
 def device_trace(log_dir: str) -> Iterator[None]:
     """Capture an on-device profiler trace (view with Perfetto/TensorBoard)."""
