@@ -121,13 +121,43 @@ def test_sigterm_mid_run_flushes_partial_json():
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--force-cpu", "--rows", "2000", "--budget", "600"],
-        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
+    import threading
     import time
 
-    time.sleep(8)  # mid first leg
-    proc.send_signal(signal.SIGTERM)
-    out, _ = proc.communicate(timeout=30)
+    try:
+        # Wait for the orchestrator's own log lines rather than sleeping a
+        # fixed interval: under host load a blind sleep can deliver SIGTERM
+        # before the flush handlers are installed (observed flake). The
+        # handlers go in at orchestrate() start, strictly before any leg
+        # log, so two leg-lines seen on stderr means the handler is live.
+        # The reader thread keeps draining stderr afterward so the child
+        # never blocks on a full pipe.
+        seen = threading.Event()
+        count = 0
+
+        def _drain():
+            nonlocal count
+            for line in proc.stderr:
+                count += 1
+                if count >= 2:
+                    seen.set()
+
+        t = threading.Thread(target=_drain, daemon=True)
+        t.start()
+        assert seen.wait(timeout=120), "orchestrator produced no log lines"
+        time.sleep(1)  # mid-leg, handler installed
+        proc.send_signal(signal.SIGTERM)
+        # stderr is owned by the drain thread; bound the exit wait, then
+        # read stdout (at EOF by then — the flush handler os._exits).
+        proc.wait(timeout=60)
+        out = proc.stdout.read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
     line = out.strip().splitlines()[-1]
     payload = json.loads(line)
     assert "metric" in payload and "vs_baseline" in payload
