@@ -24,6 +24,6 @@ Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
   L0  ops / native             — Pallas kernels, XLA collectives, C++ runtime
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"  # major.round (round 4 of the continuous build)
 
 from machine_learning_replications_tpu import config as config  # noqa: F401

@@ -6,9 +6,12 @@ cell/char variables as object arrays of strings), or ``None`` when the
 shared library is unavailable and cannot be built — ``data.matloader``
 falls back to scipy in that case.
 
-The library is compiled once per checkout with g++ (``-O2 -fPIC -lz``)
-into this package directory; a stale object (older than the source) is
-rebuilt. Set ``MLR_TPU_NO_NATIVE=1`` to disable the native path entirely.
+The library is compiled once per checkout with g++ (``-O2 -fPIC -lz``),
+preferentially into this package directory; when that is read-only (e.g. a
+system-site ``pip install``), into a per-user cache dir keyed by the source
+mtime instead, so packaged installs keep the native path. A stale object
+(older than the source) is rebuilt. Set ``MLR_TPU_NO_NATIVE=1`` to disable
+the native path entirely.
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import tempfile
 import threading
 
 import numpy as np
@@ -27,15 +31,94 @@ _lock = threading.Lock()
 _lib_cache: list = []  # [lib-or-None] once resolved
 
 
-def _build() -> bool:
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", _SO, "-lz"]
+def _cache_so() -> str | None:
+    """Fallback build target when the package dir is not writable: a
+    per-user 0700 cache dir keyed by the source mtime (a source update gets
+    a fresh name, so staleness never needs an unlink of a mapped .so).
+
+    Loading a .so executes it, so the dir must belong to this user and be
+    private: it is created 0700, and an existing dir with the wrong owner
+    or group/other permissions is refused (predictable /tmp names are
+    otherwise plantable by other local users). Returns None when no safe
+    dir can be had (the caller then gives up on the native path)."""
+    uid = getattr(os, "getuid", lambda: 0)()  # no getuid on Windows
+    try:
+        tag = int(os.path.getmtime(_SRC))
+    except OSError:
+        tag = 0
+    d = os.path.join(tempfile.gettempdir(), f"mlr_tpu_native_{uid}")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.lstat(d)
+        import stat as stat_mod
+
+        if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != uid \
+                or (st.st_mode & 0o077):
+            return None
+    except OSError:
+        return None
+    return os.path.join(d, f"_matio_{tag}.so")
+
+
+def _build(target: str) -> bool:
+    """Compile to a unique temp name, then rename onto ``target``: the
+    rename is atomic, so a concurrent process can never dlopen a partially
+    written file (the per-process ``_lock`` doesn't cover multi-process)."""
+    tmp = f"{target}.build{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o",
+           tmp, "-lz"]
     try:
         subprocess.run(
             cmd, check=True, capture_output=True, text=True, timeout=240
         )
+        os.replace(tmp, target)
         return True
     except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
+
+
+def _gc_stale_cache(keep: str) -> None:
+    """Unlink cache-dir siblings with a different source tag — each source
+    update otherwise leaks its predecessor's binary forever."""
+    d = os.path.dirname(keep)
+    try:
+        for f in os.listdir(d):
+            if f.startswith("_matio_") and f.endswith(".so") \
+                    and os.path.join(d, f) != keep:
+                try:
+                    os.unlink(os.path.join(d, f))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
+def _fresh(so: str) -> bool:
+    """A prebuilt .so without the source beside it counts as fresh."""
+    return os.path.exists(so) and (
+        not os.path.exists(_SRC)
+        or os.path.getmtime(so) >= os.path.getmtime(_SRC)
+    )
+
+
+def _resolve_so() -> str | None:
+    """Path of a loadable-fresh .so, building if needed; None if neither
+    the package dir nor the user cache can produce one."""
+    if _fresh(_SO):
+        return _SO
+    if not os.path.exists(_SRC):
+        return None
+    if _build(_SO):
+        return _SO
+    cached = _cache_so()
+    if cached is not None and (_fresh(cached) or _build(cached)):
+        _gc_stale_cache(cached)
+        return cached
+    return None
 
 
 def _load() -> ctypes.CDLL | None:
@@ -44,19 +127,25 @@ def _load() -> ctypes.CDLL | None:
     with _lock:
         if _lib_cache:
             return _lib_cache[0]
-        # A prebuilt .so without the source beside it counts as fresh.
-        fresh = os.path.exists(_SO) and (
-            not os.path.exists(_SRC)
-            or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-        )
-        if not fresh and not (os.path.exists(_SRC) and _build()):
+        so = _resolve_so()
+        if so is None:
             _lib_cache.append(None)
             return None
         try:
-            lib = ctypes.CDLL(_SO)
+            lib = ctypes.CDLL(so)
         except OSError:
-            _lib_cache.append(None)
-            return None
+            # e.g. a foreign-platform binary shipped in a wheel: rebuild
+            # into the user cache and retry once before giving up.
+            rebuilt = _cache_so()
+            if rebuilt is None or not (os.path.exists(_SRC)
+                                       and _build(rebuilt)):
+                _lib_cache.append(None)
+                return None
+            try:
+                lib = ctypes.CDLL(rebuilt)
+            except OSError:
+                _lib_cache.append(None)
+                return None
         lib.matio_open.restype = ctypes.c_void_p
         lib.matio_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
         lib.matio_var_count.argtypes = [ctypes.c_void_p]

@@ -1,6 +1,7 @@
 """Native C++ MAT-v5 reader vs the scipy oracle (SURVEY.md §2.4 row
 "scipy.io.loadmat"). Skips when the toolchain can't produce the library."""
 
+import os
 import numpy as np
 import pytest
 import scipy.io as sio
@@ -109,3 +110,25 @@ def test_load_data_backend_equivalence(tmp_path, native_available):
         np.asarray(Xn, dtype=np.float64), np.asarray(Xs, dtype=np.float64)
     )
     np.testing.assert_array_equal(yn, ys)
+
+
+def test_unwritable_package_dir_falls_back_to_user_cache(
+    tmp_path, monkeypatch, native_available
+):
+    """Packaged installs can land the package dir read-only; the build must
+    fall back to the per-user cache path and still produce a loadable lib.
+    ``native_available`` keeps the file's skip contract on toolchain-less
+    hosts; the delenv guards against an ambient opt-out."""
+    monkeypatch.delenv("MLR_TPU_NO_NATIVE", raising=False)
+    # Point the preferred target somewhere no process can create files.
+    monkeypatch.setattr(matio, "_SO", "/proc/nonexistent/_matio.so")
+    monkeypatch.setattr(matio, "_lib_cache", [])
+    cached = matio._cache_so()
+    assert cached is not None
+    if os.path.exists(cached):
+        os.unlink(cached)
+    lib = matio._load()
+    assert lib is not None, "fallback build did not produce a loadable lib"
+    assert os.path.exists(cached)
+    mode = os.lstat(os.path.dirname(cached)).st_mode & 0o777
+    assert mode == 0o700
