@@ -59,6 +59,21 @@ def _staged_fold_jit(est_grid: tuple):
     return jax.jit(f)
 
 
+@functools.lru_cache(maxsize=None)
+def _staged_allfolds_jit(est_grid: tuple):
+    """Jitted (batched params, X_te_all [k, n_pad, F]) → ``[k, E, n_pad]``:
+    every fold's staged holdout probabilities in ONE dispatch (the per-fold
+    variant above costs a host round trip per (depth, fold) — 15 dispatches
+    for the 3×5 bench grid; on a tunneled backend each is ~RTT-bound)."""
+
+    def f(params: tree.TreeEnsembleParams, X_te_all):
+        return jax.vmap(
+            lambda p, X_te: staged_proba1(p, X_te, est_grid)
+        )(params, X_te_all)
+
+    return jax.jit(f)
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepResult:
     """Grid AUCs and the selected cell.
@@ -87,8 +102,19 @@ def cv_sweep(
     """Run the grid: ONE vmapped fit per depth covering all folds
     (``gbdt.fit_folds`` — mask-parked rows, fold axis batched), staged
     evaluation over the ``n_estimators`` axis. The whole sweep compiles
-    ``len(max_depth_grid)`` programs; the reference-equivalent
-    ``GridSearchCV`` refits every (cell × fold) from scratch."""
+    ``len(max_depth_grid)`` fit programs; the reference-equivalent
+    ``GridSearchCV`` refits every (cell × fold) from scratch.
+
+    Dispatch structure (r4): every depth's fit is enqueued before any
+    scoring transfer, so the device works through the fits back-to-back
+    while the host computes earlier depths' AUCs; in the default
+    shared-bins protocol, candidate bins are derived once and reused
+    across depths (the bin budget is depth-independent — re-binning per
+    depth repeated identical host work; the opt-in ``per_fold_binning``
+    protocol still derives its per-fold candidates inside each depth's
+    ``fit_folds`` call); scoring is ONE dispatch per depth covering all
+    folds (``_staged_allfolds_jit``), with test folds padded to a common
+    length and the pad rows sliced off before the host-side AUC."""
     import jax
 
     X = np.asarray(X)
@@ -100,24 +126,50 @@ def cv_sweep(
     train_masks = 1.0 - test_masks
     k = sweep.cv_folds
 
-    fold_auc = np.zeros((len(depth_grid), len(est_grid), k))
-    staged_fold = _staged_fold_jit(est_grid)
-    for di, depth in enumerate(depth_grid):
+    # Shared candidate bins: bin_budget_capped depends on the bin config
+    # only, not max_depth, so one host binning serves every depth. The
+    # per-fold-binning protocol derives candidates inside fit_folds.
+    bins = None
+    if not base.per_fold_binning:
+        from machine_learning_replications_tpu.ops import binning
+
+        bins = binning.bin_features(X, gbdt.bin_budget_capped(base))
+
+    # Phase 1: enqueue all depth fits (jitted → async); nothing below
+    # forces a result until scoring, so the device queue never drains.
+    params_by_depth = []
+    for depth in depth_grid:
         cfg = dataclasses.replace(base, n_estimators=m_max, max_depth=depth)
-        params = gbdt.fit_folds(X, y, train_masks, cfg)
-        for kk, tm in enumerate(test_masks):
-            te = tm > 0.5
-            # Score each fold's HELD-OUT rows only: staging over the full
-            # matrix then masking threw away 1−1/k of the tree-apply work
-            # (measured ~4 s of an 8.6 s sweep at 20k rows). The fold
-            # slice of the batched params happens inside the jit — eager
-            # per-leaf indexing costs a dispatch round trip per leaf.
-            probs = np.asarray(staged_fold(params, X[te], kk))  # [E, n_te]
+        params_by_depth.append(
+            gbdt.fit_folds(X, y, train_masks, cfg, bins=bins)
+        )
+
+    # Phase 2: score each fold's HELD-OUT rows only (staging over the full
+    # matrix then masking threw away 1−1/k of the tree-apply work —
+    # measured ~4 s of an 8.6 s sweep at 20k rows), all folds in one
+    # dispatch per depth. Fold sizes differ by ≤1 row (StratifiedKFold);
+    # padding with row 0 keeps the batch rectangular and is sliced off
+    # before the AUC.
+    te_idx = [np.flatnonzero(tm > 0.5) for tm in test_masks]
+    n_te = np.array([len(ix) for ix in te_idx])
+    n_pad = int(n_te.max())
+    padded = np.stack(
+        [np.pad(ix, (0, n_pad - len(ix))) for ix in te_idx]
+    )                                   # [k, n_pad] row ids (pad = row 0)
+    X_te_all = X[padded]                # [k, n_pad, F]
+
+    fold_auc = np.zeros((len(depth_grid), len(est_grid), k))
+    staged_all = _staged_allfolds_jit(est_grid)
+    for di, params in enumerate(params_by_depth):
+        probs = np.asarray(staged_all(params, X_te_all))  # [k, E, n_pad]
+        for kk in range(k):
             # Grid selection is a host-side decision (GridSearchCV's
             # cv_results_ analogue); the vectorized rank AUC evaluates all
             # n_estimators cells in one pass and matches
             # metrics.roc_auc's tie-averaged U statistic exactly.
-            fold_auc[di, :, kk] = roc_auc_batch_host(y[te], probs)
+            fold_auc[di, :, kk] = roc_auc_batch_host(
+                y[te_idx[kk]], probs[kk][:, : n_te[kk]]
+            )
 
     mean_auc = fold_auc.mean(axis=-1)
     di, ei = np.unravel_index(np.argmax(mean_auc), mean_auc.shape)

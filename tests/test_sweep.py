@@ -53,6 +53,39 @@ def test_cv_sweep_selects_and_refits(cohort_full):
     assert np.all((np.asarray(p) >= 0) & (np.asarray(p) <= 1))
 
 
+def test_batched_fold_scoring_matches_per_fold(cohort_full):
+    """The one-dispatch-per-depth scoring path (all folds vmapped, padded)
+    must reproduce the per-(depth, fold) dispatch path on the unpadded
+    rows (tight tolerance, not bitwise: the batched and per-fold programs
+    compile separately and XLA may fuse/accumulate differently on TPU)."""
+    from machine_learning_replications_tpu.utils.cv import (
+        stratified_kfold_test_masks,
+    )
+
+    X, y, _ = cohort_full
+    Xs = np.asarray(X[:, selected_indices()])
+    y = np.asarray(y, dtype=np.float64)
+    k, est_grid = 3, (5, 15)
+    test_masks = stratified_kfold_test_masks(y, k)
+    params = gbdt.fit_folds(
+        Xs, y, 1.0 - test_masks, GBDTConfig(n_estimators=15)
+    )
+
+    te_idx = [np.flatnonzero(tm > 0.5) for tm in test_masks]
+    n_pad = max(len(ix) for ix in te_idx)
+    padded = np.stack([np.pad(ix, (0, n_pad - len(ix))) for ix in te_idx])
+    batched = np.asarray(
+        sweep._staged_allfolds_jit(est_grid)(params, Xs[padded])
+    )
+    per_fold = sweep._staged_fold_jit(est_grid)
+    for kk, ix in enumerate(te_idx):
+        np.testing.assert_allclose(
+            batched[kk][:, : len(ix)],
+            np.asarray(per_fold(params, Xs[ix], kk)),
+            rtol=1e-6, atol=1e-7,
+        )
+
+
 def test_sweep_matches_sklearn_gridsearch(cohort_full):
     """Differential vs sklearn GridSearchCV on a small grid: per-cell mean
     CV AUC within the parity budget (±0.005, BASELINE.json)."""
