@@ -44,6 +44,45 @@ def test_knn_impute_matches_sklearn(cohort):
     np.testing.assert_allclose(np.asarray(X_ours), X_sk, rtol=1e-12, atol=1e-12)
 
 
+def test_knn_impute_complete_donor_columns_share_argmin(cohort):
+    """The specialised block fn routes donor-complete columns straight to
+    the global top-1 neighbor (``_block_fn``'s unmasked branch) — sklearn
+    parity must hold when the fit cohort is fully observed and only
+    queries have NaN, and in the mixed case (some donor columns NaN, some
+    complete)."""
+    from sklearn.impute import KNNImputer
+    from machine_learning_replications_tpu.data import make_cohort
+
+    X, _, _ = cohort                      # donors WITH missingness (mixed)
+    X_full = np.asarray(X)
+    X_complete = np.where(np.isnan(X_full), np.nanmean(X_full, axis=0), X_full)
+    Xq, _, _ = make_cohort(n=150, seed=31, missing_rate=0.10)
+
+    # all-shared: every donor column complete
+    sk = KNNImputer(n_neighbors=1).fit(X_complete)
+    params = knn_impute.fit(jnp.asarray(X_complete))
+    np.testing.assert_allclose(
+        np.asarray(knn_impute.transform(params, jnp.asarray(Xq))),
+        sk.transform(np.asarray(Xq)), rtol=1e-12, atol=1e-12,
+    )
+
+    # mixed: NaN donors in some query-NaN columns, complete in others
+    X_mixed = np.array(X_full)
+    nan_cols = np.flatnonzero(np.isnan(X_full).any(axis=0))
+    fixed = nan_cols[: len(nan_cols) // 2]
+    X_mixed[:, fixed] = np.where(
+        np.isnan(X_full[:, fixed]),
+        np.nanmean(X_full[:, fixed], axis=0),
+        X_full[:, fixed],
+    )
+    sk2 = KNNImputer(n_neighbors=1).fit(X_mixed)
+    params2 = knn_impute.fit(jnp.asarray(X_mixed))
+    np.testing.assert_allclose(
+        np.asarray(knn_impute.transform(params2, jnp.asarray(Xq))),
+        sk2.transform(np.asarray(Xq)), rtol=1e-12, atol=1e-12,
+    )
+
+
 def test_knn_impute_transform_other_cohort(cohort):
     from sklearn.impute import KNNImputer
     from machine_learning_replications_tpu.data import make_cohort
