@@ -107,11 +107,35 @@ def log(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _host_cache_tag() -> str:
+    """Short fingerprint of the host's CPU feature set. The sandbox can
+    migrate between machine types while /tmp survives; XLA:CPU AOT cache
+    entries compiled for the old host's features then load with a
+    machine-mismatch warning ("could lead to execution errors such as
+    SIGILL") — keying the cache dir by the feature set keeps reuse
+    same-host only."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 spells it 'flags'; aarch64 spells it 'Features'
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine() or "unknown"
+
+
 def _enable_compile_cache(env: dict, dirname: str) -> None:
     """Point a leg env at a persistent XLA compilation cache so retry
     attempts and repeat legs don't re-pay the compile wall. ``setdefault``
     so an operator-provided cache dir wins; best-effort on mkdir failure."""
-    cache = os.path.join(tempfile.gettempdir(), dirname)
+    cache = os.path.join(
+        tempfile.gettempdir(), f"{dirname}_{_host_cache_tag()}"
+    )
     try:
         os.makedirs(cache, exist_ok=True)
         env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
