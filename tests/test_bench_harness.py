@@ -32,6 +32,40 @@ def _load_bench():
 bench = _load_bench()
 
 
+class TestHostCacheTag:
+    def test_stable_and_short(self):
+        t1, t2 = bench._host_cache_tag(), bench._host_cache_tag()
+        assert t1 == t2 and 1 <= len(t1) <= 16
+
+    def test_feature_line_changes_tag(self, tmp_path, monkeypatch):
+        """Different CPU feature lines must map to different cache dirs —
+        the whole point of the tag (a /tmp surviving a machine-type
+        migration must not serve stale AOT executables). Covers both the
+        x86 'flags' and aarch64 'Features' spellings."""
+        real_open = open
+
+        def fake_cpuinfo(content):
+            def _open(path, *a, **k):
+                if path == "/proc/cpuinfo":
+                    p = tmp_path / "cpuinfo"
+                    p.write_text(content)
+                    return real_open(p, *a, **k)
+                return real_open(path, *a, **k)
+            return _open
+
+        import builtins
+
+        monkeypatch.setattr(builtins, "open", fake_cpuinfo("flags\t: a b c\n"))
+        t_x86 = bench._host_cache_tag()
+        monkeypatch.setattr(builtins, "open", fake_cpuinfo("flags\t: a b d\n"))
+        t_x86_other = bench._host_cache_tag()
+        monkeypatch.setattr(
+            builtins, "open", fake_cpuinfo("Features\t: fp asimd\n")
+        )
+        t_arm = bench._host_cache_tag()
+        assert len({t_x86, t_x86_other, t_arm}) == 3
+
+
 class TestProbeParser:
     def test_tpu_platform_accepted(self):
         out = "warning: stuff\nPROBE_OK tpu | TPU v5 lite\n"
