@@ -83,6 +83,49 @@ def test_knn_impute_complete_donor_columns_share_argmin(cohort):
     )
 
 
+def test_block_fn_specialisation_resolution(cohort):
+    """_block_fn_for derives nan_cols from the query and the masked subset
+    from the donor matrix: donor-complete columns must NOT be in the
+    masked set (they ride the top-1 branch), and fully-observed query
+    columns must not appear at all."""
+    import numpy as np
+
+    X, _, _ = cohort
+    X_np = np.asarray(X)
+    params = knn_impute.fit(jnp.asarray(X_np))
+    q_nan_cols = set(np.flatnonzero(np.isnan(X_np).any(axis=0)))
+    donor_nan_cols = set(
+        np.flatnonzero(np.isnan(np.asarray(params.donors)).any(axis=0))
+    )
+
+    captured = {}
+    orig = knn_impute._block_fn
+
+    def spy(nan_cols, masked):
+        captured["nan_cols"], captured["masked"] = nan_cols, masked
+        return orig(nan_cols, masked)
+
+    knn_impute._block_fn, _restore = spy, orig
+    try:
+        knn_impute._block_fn_for(params, X_np)
+    finally:
+        knn_impute._block_fn = _restore
+
+    assert set(captured["nan_cols"]) == q_nan_cols
+    assert set(captured["masked"]) == q_nan_cols & donor_nan_cols
+
+    # complete donors -> empty masked set even when queries have NaN
+    X_complete = np.where(np.isnan(X_np), np.nanmean(X_np, axis=0), X_np)
+    p2 = knn_impute.fit(jnp.asarray(X_complete))
+    knn_impute._block_fn = spy
+    try:
+        knn_impute._block_fn_for(p2, X_np)
+    finally:
+        knn_impute._block_fn = _restore
+    assert captured["masked"] == ()
+    assert set(captured["nan_cols"]) == q_nan_cols
+
+
 def test_knn_impute_transform_other_cohort(cohort):
     from sklearn.impute import KNNImputer
     from machine_learning_replications_tpu.data import make_cohort
