@@ -220,7 +220,8 @@ def cmd_sweep(args) -> int:
         max_depth_grid=tuple(args.max_depth),
         cv_folds=args.folds,
     )
-    res = sweep.cv_sweep(X, y, cfg)
+    mesh = _build_mesh(args)
+    res = sweep.cv_sweep(X, y, cfg, mesh=mesh)
     print(f"{'depth':>6} " + " ".join(f"m={m:>5d}" for m in res.n_estimators_grid))
     for di, d in enumerate(res.max_depth_grid):
         print(
@@ -234,7 +235,7 @@ def cmd_sweep(args) -> int:
     if args.save:
         from machine_learning_replications_tpu.persist import orbax_io
 
-        params, _ = sweep.refit_best(X, y, res)
+        params, _ = sweep.refit_best(X, y, res, mesh=mesh)
         orbax_io.save_model(args.save, params)
         print(f"refit best model checkpointed to {args.save}", file=sys.stderr)
     return 0
@@ -275,19 +276,24 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--seed", type=int, default=2020)
         p.add_argument("--config", help="ExperimentConfig JSON path")
 
+    def add_mesh_flags(p, what: str):
+        p.add_argument(
+            "--mesh", default=None,
+            help="device-mesh shape DATA[,MODEL] (e.g. 8 or 4,2) or 'auto' "
+            f"(all devices on the data axis); {what}",
+        )
+        p.add_argument(
+            "--distributed", action="store_true",
+            help="bring up jax.distributed (multi-host) before building "
+            "the mesh",
+        )
+
     t = sub.add_parser("train", help="fit the full pipeline and evaluate")
     add_cohort_flags(t)
     t.add_argument("--save", help="Orbax checkpoint directory to write")
     t.add_argument("--plots", help="directory for roc.png / pr.png")
-    t.add_argument(
-        "--mesh", default=None,
-        help="device-mesh shape DATA[,MODEL] (e.g. 8 or 4,2) or 'auto' "
-        "(all devices on the data axis); routes the GBDT member through "
-        "the row-sharded trainers",
-    )
-    t.add_argument(
-        "--distributed", action="store_true",
-        help="bring up jax.distributed (multi-host) before building the mesh",
+    add_mesh_flags(
+        t, "routes the GBDT member through the row-sharded trainers"
     )
     t.add_argument(
         "--resume-dir", default=None,
@@ -310,6 +316,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-depth", type=int, nargs="+", default=[1, 2, 3])
     s.add_argument("--folds", type=int, default=5)
     s.add_argument("--save", help="checkpoint the refit best model here")
+    add_mesh_flags(
+        s, "each (depth, fold) fit and the best-cell refit run row-sharded "
+        "(fold masks ride the trainers' weight path)"
+    )
     s.set_defaults(fn=cmd_sweep)
 
     i = sub.add_parser("import-sklearn", help="legacy pickle → Orbax")

@@ -98,6 +98,7 @@ def cv_sweep(
     y: np.ndarray,
     sweep: SweepConfig = SweepConfig(),
     base: GBDTConfig = GBDTConfig(),
+    mesh=None,
 ) -> SweepResult:
     """Run the grid: ONE vmapped fit per depth covering all folds
     (``gbdt.fit_folds`` — mask-parked rows, fold axis batched), staged
@@ -114,7 +115,14 @@ def cv_sweep(
     protocol still derives its per-fold candidates inside each depth's
     ``fit_folds`` call); scoring is ONE dispatch per depth covering all
     folds (``_staged_allfolds_jit``), with test folds padded to a common
-    length and the pad rows sliced off before the host-side AUC."""
+    length and the pad rows sliced off before the host-side AUC.
+
+    With ``mesh``, each (depth, fold) fit runs row-sharded through
+    ``parallel.fit_gbdt_sharded`` (fold masks ride the trainers' weight
+    path; SURVEY §2.5's "grid sharded across chips" axis) and the fold
+    results are stacked into the same batched-params layout the
+    single-device path produces, so scoring is identical. The mesh path
+    uses the shared-bins protocol only."""
     import jax
 
     X = np.asarray(X)
@@ -125,6 +133,12 @@ def cv_sweep(
     test_masks = stratified_kfold_test_masks(y, sweep.cv_folds)
     train_masks = 1.0 - test_masks
     k = sweep.cv_folds
+
+    if mesh is not None and base.per_fold_binning:
+        raise ValueError(
+            "cv_sweep(mesh=...) runs the shared-bins protocol only; "
+            "per_fold_binning is a single-device option (fit_folds)"
+        )
 
     # Shared candidate bins: bin_budget_capped depends on the bin config
     # only, not max_depth, so one host binning serves every depth. The
@@ -140,9 +154,25 @@ def cv_sweep(
     params_by_depth = []
     for depth in depth_grid:
         cfg = dataclasses.replace(base, n_estimators=m_max, max_depth=depth)
-        params_by_depth.append(
-            gbdt.fit_folds(X, y, train_masks, cfg, bins=bins)
-        )
+        if mesh is None:
+            params_by_depth.append(
+                gbdt.fit_folds(X, y, train_masks, cfg, bins=bins)
+            )
+        else:
+            from machine_learning_replications_tpu.parallel import (
+                fit_gbdt_sharded,
+            )
+
+            per_fold = [
+                fit_gbdt_sharded(
+                    mesh, X, y, cfg,
+                    sample_weight=np.asarray(train_masks[kk]), bins=bins,
+                )[0]
+                for kk in range(k)
+            ]
+            params_by_depth.append(
+                jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_fold)
+            )
 
     # Phase 2: score each fold's HELD-OUT rows only (staging over the full
     # matrix then masking threw away 1−1/k of the tree-apply work —
@@ -189,12 +219,22 @@ def refit_best(
     y: np.ndarray,
     result: SweepResult,
     base: GBDTConfig = GBDTConfig(),
+    mesh=None,
 ) -> tuple[tree.TreeEnsembleParams, GBDTConfig]:
-    """Refit the winning cell on the full data (``GridSearchCV(refit=True)``)."""
+    """Refit the winning cell on the full data (``GridSearchCV(refit=True)``).
+
+    With ``mesh`` the refit runs row-sharded (``parallel.fit_gbdt_sharded``)
+    — a sweep that needed sharding to fit must not funnel its final refit
+    through one device."""
     cfg = dataclasses.replace(
         base,
         n_estimators=result.best_n_estimators,
         max_depth=result.best_max_depth,
     )
-    params, _ = gbdt.fit(X, y, cfg)
+    if mesh is not None:
+        from machine_learning_replications_tpu.parallel import fit_gbdt_sharded
+
+        params, _ = fit_gbdt_sharded(mesh, X, y, cfg)
+    else:
+        params, _ = gbdt.fit(X, y, cfg)
     return params, cfg

@@ -353,3 +353,28 @@ def test_mesh_cross_val_per_fold_binning_matches_single_device(train_data):
     np.testing.assert_allclose(
         meta_mesh[:, 1], meta_single[:, 1], rtol=1e-7, atol=1e-9
     )
+
+
+def test_mesh_sweep_matches_single_device(train_data):
+    """cv_sweep(mesh=...) — each (depth, fold) fit row-sharded with the
+    fold mask riding the trainers' weight path — must reproduce the
+    single-device vmapped sweep's AUC surface (the sharded and vmapped
+    trainers are independently parity-tested; this checks the sweep-level
+    composition end to end)."""
+    from machine_learning_replications_tpu.config import SweepConfig
+    from machine_learning_replications_tpu.models import sweep
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    X, y = train_data
+    cfg = SweepConfig(
+        n_estimators_grid=(5, 12), max_depth_grid=(1, 2), cv_folds=3
+    )
+    single = sweep.cv_sweep(X, y, cfg)
+    mesh = make_mesh(data=4, model=2)
+    sharded = sweep.cv_sweep(X, y, cfg, mesh=mesh)
+    np.testing.assert_allclose(
+        sharded.fold_auc, single.fold_auc, rtol=0, atol=1e-9
+    )
+    assert sharded.best_max_depth == single.best_max_depth
+    assert sharded.best_n_estimators == single.best_n_estimators
