@@ -209,6 +209,11 @@ def cmd_sweep(args) -> int:
 
     import jax.numpy as jnp
 
+    # Mesh (and --distributed bring-up) BEFORE any JAX computation:
+    # jax.distributed.initialize must precede backend init, and the
+    # imputation below touches the backend (cmd_train orders it the same).
+    mesh = _build_mesh(args)
+
     X64, y = _load_cohort(args, "develop")
     if np.isnan(X64).any():
         _, X64 = knn_impute.fit_transform(jnp.asarray(X64))
@@ -220,7 +225,6 @@ def cmd_sweep(args) -> int:
         max_depth_grid=tuple(args.max_depth),
         cv_folds=args.folds,
     )
-    mesh = _build_mesh(args)
     res = sweep.cv_sweep(X, y, cfg, mesh=mesh)
     print(f"{'depth':>6} " + " ".join(f"m={m:>5d}" for m in res.n_estimators_grid))
     for di, d in enumerate(res.max_depth_grid):

@@ -229,6 +229,31 @@ def run(n_devices: int) -> None:
     _say(f"phase 7 done: sharded lasso fold-Gram selection == single-device "
          f"({time.time() - t:.1f}s)")
 
+    # Phase 8 — the CV grid sweep (BASELINE config 4) row-sharded: each
+    # (depth, fold) fit through fit_gbdt_sharded with the fold mask on the
+    # trainers' weight path; the AUC surface must match the single-device
+    # vmapped sweep. Continuous features on purpose: the sharded (sorted
+    # stump / hist) and vmapped (level-wise) trainers may break EQUAL-GAIN
+    # split ties differently — both sklearn-legal — and the tiny
+    # mostly-binary cohort above is tie-dense.
+    t = time.time()
+    from machine_learning_replications_tpu.config import SweepConfig
+    from machine_learning_replications_tpu.models import sweep as sweep_mod
+
+    rng = np.random.default_rng(11)
+    Xc = rng.normal(size=(128, 6))
+    yc = (Xc @ rng.normal(size=6) + 0.5 * rng.normal(size=128) > 0).astype(float)
+    scfg = SweepConfig(
+        n_estimators_grid=(2, 4), max_depth_grid=(1, 2), cv_folds=2
+    )
+    sw_sh = sweep_mod.cv_sweep(Xc, yc, scfg, mesh=mesh)
+    sw_sd = sweep_mod.cv_sweep(Xc, yc, scfg)
+    np.testing.assert_allclose(
+        sw_sh.fold_auc, sw_sd.fold_auc, rtol=0, atol=1e-9
+    )
+    _say(f"phase 8 done: mesh grid sweep AUC surface == single-device "
+         f"({time.time() - t:.1f}s)")
+
     _say(f"dryrun_multichip OK in {time.time() - t_all:.1f}s: mesh "
          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, all phases "
          "parity-checked")
