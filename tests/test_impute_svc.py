@@ -126,6 +126,64 @@ def test_block_fn_specialisation_resolution(cohort):
     assert set(captured["nan_cols"]) == q_nan_cols
 
 
+def _impute_oracle(donors, col_means, Xq):
+    """Brute-force sklearn-semantics 1-NN imputation: full masked distance
+    scan per feature, first-index tie-break — the spec the top-K fast path
+    plus cond-gated fallback must reproduce exactly."""
+    nd, F = donors.shape
+    out = np.array(Xq, dtype=float)
+    for i in range(Xq.shape[0]):
+        q = Xq[i]
+        qm = ~np.isnan(q)
+        d = np.full(nd, np.inf)
+        for j in range(nd):
+            m = qm & ~np.isnan(donors[j])
+            if m.any():
+                diff = q[m] - donors[j][m]
+                d[j] = (diff @ diff) * F / m.sum()
+        for f in range(F):
+            if not np.isnan(q[f]):
+                continue
+            df = np.where(~np.isnan(donors[:, f]), d, np.inf)
+            jmin = int(np.argmin(df))  # first index among ties
+            out[i, f] = donors[jmin, f] if np.isfinite(df[jmin]) \
+                else col_means[f]
+    return out
+
+
+def test_knn_impute_topk_matches_bruteforce_oracle():
+    """Randomized differential: many NaN patterns (incl. donor pools
+    smaller than K=8, high missingness forcing the exact fallback, and
+    tie-heavy integer-valued features) against the brute-force oracle."""
+    rng = np.random.default_rng(404)
+    for trial in range(12):
+        nd = int(rng.integers(3, 40))
+        nq = int(rng.integers(2, 25))
+        F = int(rng.integers(2, 9))
+        # integer-valued features make distance ties common
+        donors = rng.integers(0, 3, size=(nd, F)).astype(float)
+        Xq = rng.integers(0, 3, size=(nq, F)).astype(float)
+        miss_d = rng.random(size=donors.shape) < rng.uniform(0.05, 0.5)
+        miss_q = rng.random(size=Xq.shape) < rng.uniform(0.1, 0.6)
+        donors[miss_d] = np.nan
+        Xq[miss_q] = np.nan
+        donors[0, :] = 0.0  # keep at least one complete donor row
+        col_means = np.nanmean(
+            np.where(np.isnan(donors), np.nanmean(donors, axis=0), donors),
+            axis=0,
+        )
+        params = knn_impute.KNNImputerParams(
+            donors=jnp.asarray(donors),
+            col_means=jnp.asarray(np.nan_to_num(col_means)),
+        )
+        ours = np.asarray(knn_impute.transform(params, jnp.asarray(Xq)))
+        oracle = _impute_oracle(
+            donors, np.nan_to_num(col_means), Xq
+        )
+        np.testing.assert_allclose(ours, oracle, rtol=1e-12, atol=1e-12,
+                                   err_msg=f"trial {trial}")
+
+
 def test_knn_impute_transform_other_cohort(cohort):
     from sklearn.impute import KNNImputer
     from machine_learning_replications_tpu.data import make_cohort
