@@ -31,6 +31,8 @@ import sys
 
 import numpy as np
 
+from machine_learning_replications_tpu import __version__
+
 
 def _load_cohort(args, which: str):
     """(X64, y) from a .mat path or the synthetic generator."""
@@ -264,6 +266,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m machine_learning_replications_tpu",
         description=__doc__.split("\n\n")[0],
+    )
+    ap.add_argument(
+        "--version", action="version",
+        version=f"machine-learning-replications-tpu {__version__}",
     )
     sub = ap.add_subparsers(dest="command", required=True)
 

@@ -168,18 +168,13 @@ def test_knn_impute_topk_matches_bruteforce_oracle():
         donors[miss_d] = np.nan
         Xq[miss_q] = np.nan
         donors[0, :] = 0.0  # keep at least one complete donor row
-        col_means = np.nanmean(
-            np.where(np.isnan(donors), np.nanmean(donors, axis=0), donors),
-            axis=0,
-        )
+        col_means = np.nanmean(donors, axis=0)  # same quantity fit() uses
         params = knn_impute.KNNImputerParams(
             donors=jnp.asarray(donors),
-            col_means=jnp.asarray(np.nan_to_num(col_means)),
+            col_means=jnp.asarray(col_means),
         )
         ours = np.asarray(knn_impute.transform(params, jnp.asarray(Xq)))
-        oracle = _impute_oracle(
-            donors, np.nan_to_num(col_means), Xq
-        )
+        oracle = _impute_oracle(donors, col_means, Xq)
         np.testing.assert_allclose(ours, oracle, rtol=1e-12, atol=1e-12,
                                    err_msg=f"trial {trial}")
 
