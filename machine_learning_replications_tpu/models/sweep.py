@@ -60,6 +60,18 @@ def _staged_fold_jit(est_grid: tuple):
 
 
 @functools.lru_cache(maxsize=None)
+def _staged_rows_fn(est_grid: tuple):
+    """Row-leading staged probabilities ``(params, X_rows) → [n, E]`` — the
+    per-row map shape ``parallel.rowwise.apply_rows_sharded`` consumes (the
+    mesh path's scorer; zero-pad rows flow through and are sliced off)."""
+
+    def f(params: tree.TreeEnsembleParams, X_rows):
+        return staged_proba1(params, X_rows, est_grid).T
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
 def _staged_allfolds_jit(est_grid: tuple):
     """Jitted (batched params, X_te_all [k, n_pad, F]) → ``[k, E, n_pad]``:
     every fold's staged holdout probabilities in ONE dispatch (the per-fold
@@ -119,10 +131,11 @@ def cv_sweep(
 
     With ``mesh``, each (depth, fold) fit runs row-sharded through
     ``parallel.fit_gbdt_sharded`` (fold masks ride the trainers' weight
-    path; SURVEY §2.5's "grid sharded across chips" axis) and the fold
+    path; SURVEY §2.5's "grid sharded across chips" axis), the fold
     results are stacked into the same batched-params layout the
-    single-device path produces, so scoring is identical. The mesh path
-    uses the shared-bins protocol only."""
+    single-device path produces, and the staged holdout scoring runs
+    row-sharded too (``apply_rows_sharded`` per fold). The mesh path uses
+    the shared-bins protocol only."""
     import jax
 
     X = np.asarray(X)
@@ -191,7 +204,29 @@ def cv_sweep(
     fold_auc = np.zeros((len(depth_grid), len(est_grid), k))
     staged_all = _staged_allfolds_jit(est_grid)
     for di, params in enumerate(params_by_depth):
-        probs = np.asarray(staged_all(params, X_te_all))  # [k, E, n_pad]
+        if mesh is None:
+            probs = np.asarray(staged_all(params, X_te_all))  # [k, E, n_pad]
+        else:
+            # Mesh scoring: each fold's held-out rows sharded over 'data'
+            # (the single-device [k, E, n_pad] batch would materialize the
+            # whole held-out cohort — ~GBs at multi-million-row sweeps —
+            # on one chip). Replicated per-fold params, per-row map.
+            from machine_learning_replications_tpu.parallel.rowwise import (
+                apply_rows_sharded,
+            )
+
+            # Enqueue every fold's dispatch before the first transfer —
+            # a fold-by-fold np.asarray would serialize k RTT round trips
+            # (the pattern _staged_allfolds_jit exists to avoid).
+            pending = [
+                apply_rows_sharded(
+                    mesh, _staged_rows_fn(est_grid),
+                    jax.tree.map(lambda a, kk=kk: a[kk], params),
+                    X_te_all[kk],
+                )
+                for kk in range(k)
+            ]
+            probs = np.stack([np.asarray(p).T for p in pending])
         for kk in range(k):
             # Grid selection is a host-side decision (GridSearchCV's
             # cv_results_ analogue); the vectorized rank AUC evaluates all
