@@ -110,6 +110,7 @@ class _Args:
     config = None
     rows = None
     budget = 1800
+    detail_out = None
 
 
 class TestFlushPayload:
@@ -134,8 +135,10 @@ class TestFlushPayload:
         assert payload["value"] == 0.0
         json.dumps(payload)
 
-    def test_emit_is_single_shot(self, capsys):
-        state = bench._RunState(_Args())
+    def test_emit_is_single_shot(self, capsys, tmp_path):
+        args = _Args()
+        args.detail_out = str(tmp_path / "detail.json")
+        state = bench._RunState(args)
         state.results["3"] = {"metric": "m", "value": 1.0, "unit": "s",
                               "vs_baseline": 2.0, "parity_ok": True}
         rc1 = state.emit()
@@ -143,6 +146,118 @@ class TestFlushPayload:
         out = capsys.readouterr().out
         assert len(out.strip().splitlines()) == 1  # exactly one JSON line
         assert rc1 == 0 and rc2 == 1
+
+
+def _loaded_state(tmp_path, n_configs=5, err_len=0):
+    """A _RunState carrying a realistically fat five-config result set —
+    the shape whose full-payload line overflowed the driver's tail window
+    in BENCH_r04 (rc 0, ``parsed: null``)."""
+    args = _Args()
+    args.detail_out = str(tmp_path / "detail.json")
+    state = bench._RunState(args)
+    for c in range(1, n_configs + 1):
+        rec = {
+            "metric": f"config{c}_train_wall_clock_1000000rows",
+            "value": 1.234567, "unit": "s", "vs_baseline": 93.97,
+            "vs_baseline_cold": 2.8, "device": "axon:TPU v5 lite",
+            "parity_ok": True, "rows": 1_000_000, "auc": 0.93123456,
+            "auc_delta_vs_sklearn": 2.4e-4, "value_cold_s": 27.5,
+            "baseline_wall_s": 76.1234, "repeats": 3,
+            "phases_s": {f"phase_{i}": 0.123456 for i in range(12)},
+            "mfu_pct": 0.021, "hbm_util_pct": 8.9,
+            "note": "x" * 120,
+        }
+        if err_len:
+            rec = {"error": "E" * err_len, "tpu_error": "T" * err_len}
+        state.results[str(c)] = rec
+    for i in range(24):
+        state.probe_log.append(
+            {"t": "04:00:00", "timeout_s": 300, "outcome": "timeout",
+             "wall_s": 300.0}
+        )
+    return state
+
+
+class TestSummaryLine:
+    """The stdout line must fit the driver's tail/parse window (VERDICT r4
+    missing #1 / weak #1): hard cap, contract keys, detail file."""
+
+    def test_five_fat_configs_fit_cap(self, tmp_path):
+        state = _loaded_state(tmp_path)
+        payload = state.build_payload()
+        line = state.summary_line(payload, state.args.detail_out)
+        assert len(line) <= bench.SUMMARY_LINE_CAP
+        parsed = json.loads(line)
+        for key in ("metric", "value", "unit", "vs_baseline"):
+            assert key in parsed
+        # every config is represented in the digest
+        assert set(parsed["configs"]) == {"1", "2", "3", "4", "5"}
+        assert parsed["configs"]["3"]["vs_baseline"] == 93.97
+
+    def test_error_storm_still_fits_cap(self, tmp_path):
+        # Worst case: every config failed with a long error string (the
+        # tunnel-wedge transcript shape). The digest truncates; never drops
+        # the contract keys.
+        state = _loaded_state(tmp_path, err_len=2000)
+        payload = state.build_payload(partial="flushed on signal 15 (SIGTERM)")
+        line = state.summary_line(payload, state.args.detail_out)
+        assert len(line) <= bench.SUMMARY_LINE_CAP
+        parsed = json.loads(line)
+        # headline config 3 carries only an error record → build_payload's
+        # head.get("metric", ...) default names the failure
+        assert parsed["metric"] == "config3_failed"
+        assert parsed["config_errors"] == 5
+
+    def test_emit_writes_full_payload_to_detail_file(self, tmp_path, capsys):
+        state = _loaded_state(tmp_path)
+        rc = state.emit()
+        out = capsys.readouterr().out.strip()
+        assert rc == 0
+        line = out.splitlines()[-1]
+        assert len(line) <= bench.SUMMARY_LINE_CAP
+        parsed = json.loads(line)
+        # outside the repo root → the full path, so the file is findable
+        # from the line alone
+        assert parsed["detail_file"] == state.args.detail_out
+        with open(state.args.detail_out) as f:
+            detail = json.load(f)
+        # the detail file carries what the stdout line cannot
+        assert detail["configs"]["3"]["phases_s"]["phase_0"] == 0.123456
+        assert len(detail["probe_log"]) == 24
+        assert detail["parity_ok"] is True
+
+    def test_detail_write_failure_still_emits(self, tmp_path, capsys):
+        # The contract line prints BEFORE the best-effort detail write, so
+        # a wedged filesystem can never gate it; a failed write just means
+        # the named file is absent (failure logged to stderr).
+        args = _Args()
+        # a FILE in the dirname position → makedirs/open raise OSError
+        (tmp_path / "blocker").write_text("")
+        args.detail_out = str(tmp_path / "blocker" / "detail.json")
+        state = bench._RunState(args)
+        state.results["3"] = {"metric": "m", "value": 1.0, "unit": "s",
+                              "vs_baseline": 2.0, "parity_ok": True}
+        rc = state.emit()
+        out = capsys.readouterr().out.strip()
+        parsed = json.loads(out.splitlines()[-1])
+        assert rc == 0
+        assert "metric" in parsed and "vs_baseline" in parsed
+        assert not os.path.exists(args.detail_out)
+
+    def test_pathological_head_sheds_keys_not_json(self, tmp_path):
+        # Even absurdly long head strings must yield VALID JSON ≤ cap —
+        # never a mid-token slice of the serialized line.
+        args = _Args()
+        args.detail_out = str(tmp_path / "detail.json")
+        state = bench._RunState(args)
+        state.results["3"] = {"metric": "m" * 3000, "value": 1.0, "unit": "s",
+                              "vs_baseline": 2.0, "parity_ok": True,
+                              "device": "d" * 500}
+        payload = state.build_payload(partial="p" * 800)
+        line = state.summary_line(payload, args.detail_out)
+        assert len(line) <= bench.SUMMARY_LINE_CAP
+        parsed = json.loads(line)  # must parse
+        assert "value" in parsed and "vs_baseline" in parsed
 
 
 @pytest.mark.slow
