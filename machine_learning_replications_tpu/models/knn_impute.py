@@ -75,7 +75,7 @@ def fit(
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def _block_fn(nan_cols: tuple, masked_donor_cols: tuple):
     """Jitted imputation block specialised to the query's NaN columns.
 
@@ -93,7 +93,10 @@ def _block_fn(nan_cols: tuple, masked_donor_cols: tuple):
         itself has NaN) need their own eligibility-masked pass.
 
     Keyed lru_cache keeps the returned function's identity stable per
-    specialisation so downstream jit caches (``apply_rows_sharded``) hit.
+    specialisation so downstream jit caches (``apply_rows_sharded``) hit;
+    bounded at 64 patterns — a long-lived server seeing varied query
+    missingness patterns must not retain compiled executables without
+    bound, and a re-trace on rare eviction is cheap (ADVICE r4).
     """
     def f(params: KNNImputerParams, X: jnp.ndarray) -> jnp.ndarray:
         X = jnp.asarray(X)
@@ -133,8 +136,15 @@ def _block_fn(nan_cols: tuple, masked_donor_cols: tuple):
                         jnp.isfinite(jnp.min(Df, axis=1)),
                     )
 
+                # Only rows whose query value in fcol is actually missing
+                # consume the imputation result — a present-value row with
+                # no eligible top-K donor must not revert the whole block
+                # to the exact pass (ADVICE r4: block-global gating decayed
+                # as (1-miss^K)^chunk_rows at high donor missingness). The
+                # fast path stays exact for every consuming row.
+                needs = jnp.isnan(X[:, fcol])
                 idx, ok = jax.lax.cond(
-                    jnp.all(any_elig | no_finite),
+                    jnp.all(any_elig | no_finite | ~needs),
                     lambda _: (idx_fast, any_elig),
                     exact,
                     None,

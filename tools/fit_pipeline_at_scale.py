@@ -53,10 +53,7 @@ def main() -> int:
     import jax
     import numpy as np
 
-    from machine_learning_replications_tpu.config import (
-        ExperimentConfig,
-        ImputerConfig,
-    )
+    from machine_learning_replications_tpu.config import ExperimentConfig
     from machine_learning_replications_tpu.data import make_cohort
     from machine_learning_replications_tpu.models import pipeline
     from machine_learning_replications_tpu.utils import metrics
@@ -64,8 +61,13 @@ def main() -> int:
 
     cfg = ExperimentConfig()
     if args.max_donors is not None:
+        # replace() on the EXISTING imputer config: a fresh ImputerConfig
+        # would silently reset chunk_rows/n_neighbors to class defaults if
+        # a non-default config is ever threaded through here (ADVICE r4).
         cfg = dataclasses.replace(
-            cfg, imputer=ImputerConfig(max_donors=args.max_donors)
+            cfg, imputer=dataclasses.replace(
+                cfg.imputer, max_donors=args.max_donors
+            )
         )
 
     d = jax.devices()[0]
