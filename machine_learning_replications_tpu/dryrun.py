@@ -254,6 +254,29 @@ def run(n_devices: int) -> None:
     _say(f"phase 8 done: mesh grid sweep AUC surface == single-device "
          f"({time.time() - t:.1f}s)")
 
+    # Phase 9 — the COMPOSED program (VERDICT r4 weak #6): fit_pipeline
+    # end-to-end on the mesh — impute → select → stack — then a sharded
+    # batch predict, against the identical fit/predict single-device.
+    # Phases 2-8 validate each stage's sharding in isolation; only a
+    # composed run can catch stage-BOUNDARY mismatches (e.g. the selected-
+    # column subset of a row-sharded imputed array feeding the stacked fit).
+    t = time.time()
+    X9, y9, _ = make_cohort(n=128, seed=7, missing_rate=0.05)
+    pp_sh, info_sh = pipeline.fit_pipeline(X9, y9, ecfg, mesh=mesh)
+    pp_sd, info_sd = pipeline.fit_pipeline(X9, y9, ecfg)
+    assert info_sh["n_selected"] == info_sd["n_selected"]
+    np.testing.assert_array_equal(
+        np.asarray(pp_sh.support_mask), np.asarray(pp_sd.support_mask)
+    )
+    Xq, _, _ = make_cohort(n=64, seed=8, missing_rate=0.05)
+    pq_sh = np.asarray(pipeline.pipeline_predict_proba1(pp_sh, Xq, mesh=mesh))
+    pq_sd = np.asarray(pipeline.pipeline_predict_proba1(pp_sd, Xq))
+    # f32 stacking members under different GSPMD reduction orders: same
+    # drift envelope as phase 5's member fits.
+    np.testing.assert_allclose(pq_sh, pq_sd, rtol=1e-3, atol=1e-5)
+    _say(f"phase 9 done: composed fit_pipeline + batch predict on the mesh "
+         f"== single-device ({time.time() - t:.1f}s)")
+
     _say(f"dryrun_multichip OK in {time.time() - t_all:.1f}s: mesh "
          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, all phases "
          "parity-checked")
