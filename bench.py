@@ -912,27 +912,38 @@ def _numpy_stacked_predict(p, X):
     return 1.0 / (1.0 + np.exp(-zm))
 
 
-def _utilization(dev_s: float, n: int, F: int, stages: int) -> dict:
-    """Hardware-efficiency accounting for the sorted-layout stump trainer
-    (VERDICT r2 item 4: a speedup claim needs a utilization denominator).
+def _utilization(dev_s: float, n: int, F: int, stages: int,
+                 mode: str = "sorted", n_bins: int = 256) -> dict:
+    """Hardware-efficiency accounting (VERDICT r2 item 4: a speedup claim
+    needs a utilization denominator). Two per-stage models:
 
-    FLOP model — per stage the trainer makes ~6 dense passes over the
-    ``[F, n]`` replicated layout (expit ≈10 flops/elt, residual/hessian ≈4,
-    two cumsums ≈2, routing compare + select + raw update ≈4) ⇒ ~20 flops
-    per element per stage. Bytes model — those passes re-read/write the
-    ``[F, n]`` float32 arrays ~8× plus one uint8 bins_x read ⇒ ~33 bytes
-    per element per stage. Both are order-of-magnitude anchors, not
-    microarchitectural truth; the workload is bandwidth-bound by design
-    (arithmetic intensity ≈ 0.6 flop/byte), so mfu_pct is honest-but-tiny
-    while hbm_util_pct is the number that should approach 100.
+    ``mode='sorted'`` — the replicated-sorted-layout trainer (the sharded
+    config-5 path): ~6 dense passes over the ``[F, n]`` layout ⇒ ~20 flops
+    and ~33 bytes per element per stage; bandwidth-bound by design
+    (intensity ≈ 0.6 flop/byte), so hbm_util_pct is the number to watch.
+    The r5 trace read (docs/SCALING.md "Roofline") showed most of its
+    per-stage time in pad/reshape data formatting, which is why the fused
+    path moved off this design.
+
+    ``mode='hist_mxu'`` — the r5 unsorted fused path (configs 2/3 at
+    device-binning scale): per stage one u8 ``[n, F]`` bin-matrix read
+    plus ~9 ``[n]`` f32 passes ⇒ ≈ n·(F + 36) bytes, and a one-hot MXU
+    contraction of 2 stats ⇒ ≈ 4·n·F·B + 25·n flops. Intensity flips to
+    ~300 flop/byte — the stage is MXU-bound, so mfu_pct is the honest
+    gauge and hbm_util_pct the small one.
     """
     import jax
 
     d = jax.devices()[0]
     peaks = CHIP_PEAKS.get(d.device_kind)
-    flops = 20.0 * n * F * stages
-    bytes_ = 33.0 * n * F * stages
+    if mode == "hist_mxu":
+        flops = (4.0 * n * F * n_bins + 25.0 * n) * stages
+        bytes_ = n * (F + 36.0) * stages
+    else:
+        flops = 20.0 * n * F * stages
+        bytes_ = 33.0 * n * F * stages
     rec = {
+        "stage_model": mode,
         "flops_est": flops,
         "bytes_est": bytes_,
         "arithmetic_intensity": round(flops / bytes_, 3),
@@ -1009,7 +1020,13 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
         "splitter": args.splitter,
         "device": _device_kind(),
         "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
-        **_utilization(dev_s, args.rows, X17.shape[1], n_estimators),
+        **_utilization(
+            dev_s, args.rows, X17.shape[1], n_estimators,
+            # same predicate fit() uses to pick the fused unsorted path
+            mode=("hist_mxu" if gbdt.uses_fused_hist1(cfg, args.rows)
+                  else "sorted"),
+            n_bins=cfg.n_bins,
+        ),
     }
     if n_estimators == 1 and cold_s > 5 * dev_s:
         # Config 2's wall is one-time trace+compile by construction: a

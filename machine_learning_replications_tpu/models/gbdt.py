@@ -52,6 +52,18 @@ def default_bins(X, cfg: GBDTConfig) -> binning.BinnedFeatures:
     return binning.bin_features(np.asarray(X), bin_budget(cfg))
 
 
+def uses_fused_hist1(cfg: GBDTConfig, n_rows: int) -> bool:
+    """Config/shape half of ``fit``'s fused-path gate (the label-binarity
+    half is data-dependent and checked in-flight via the status flag).
+    Shared with ``bench._utilization`` so the reported stage model can
+    never drift from the path the fit actually takes."""
+    return (
+        cfg.splitter == "hist"
+        and cfg.max_depth == 1
+        and n_rows >= DEVICE_BINNING_MIN_ROWS
+    )
+
+
 def fit(
     X: np.ndarray,
     y: np.ndarray,
@@ -69,8 +81,7 @@ def fit(
     """
     resolve_backend(cfg)  # validate eagerly, even on paths that ignore it
     if bins is None:
-        if cfg.splitter == "hist" and cfg.max_depth == 1 \
-                and X.shape[0] >= DEVICE_BINNING_MIN_ROWS \
+        if uses_fused_hist1(cfg, X.shape[0]) \
                 and not (
                     isinstance(y, np.ndarray)
                     and not histogram.is_binary_labels(y)
@@ -93,6 +104,7 @@ def fit(
                 learning_rate=cfg.learning_rate,
                 min_samples_split=cfg.min_samples_split,
                 min_samples_leaf=cfg.min_samples_leaf,
+                backend=resolve_backend(cfg),
             )
             feature, threshold, value, is_split, deviance, f0, status = fused
             # One sync for the whole fit. NaN is a contract violation
@@ -357,7 +369,7 @@ def _fit_stumps(
     jax.jit,
     static_argnames=(
         "n_bins", "n_stages", "learning_rate",
-        "min_samples_split", "min_samples_leaf",
+        "min_samples_split", "min_samples_leaf", "backend",
     ),
 )
 def _fit_hist1_fused(
@@ -369,11 +381,22 @@ def _fit_hist1_fused(
     learning_rate: float,
     min_samples_split: int,
     min_samples_leaf: int,
+    backend: str = "xla",
 ):
-    """Quantile binning → sorted stump layout → all boosting stages, fused
-    into a single XLA program (one dispatch, one device sync for the whole
-    fit). Equals ``bin_features_device`` + ``build_stump_data_device`` +
-    ``_fit_stumps`` run separately — pinned by
+    """Quantile binning → all boosting stages, fused into a single XLA
+    program (one dispatch, one device sync for the whole fit).
+
+    UNSORTED histogram formulation (r5): the sorted replicated layout that
+    the unfused path uses (``StumpData``, F copies of every row vector)
+    spent ~70% of each on-chip stage on pad/reshape/copy data formatting
+    feeding the blocked boundary sums, and its ``[F, F, n]`` bin tensor
+    dominated HBM residency (trace analysis, docs/SCALING.md "Roofline").
+    Here the stage state is a single ``[n]`` score vector and each stage's
+    split statistics come from ``histogram.stump_histograms`` over the
+    loop-invariant ``[n, F]`` u8 bin matrix (MXU one-hot contraction /
+    Pallas VMEM kernel on TPU), with boundary sums as tiny ``[F, B]``
+    cumsums. Same math as the sorted path up to f32 summation regrouping —
+    pinned forest-identical on the contract cohort by
     ``tests/test_gbdt_train.py::test_fused_hist1_matches_unfused``.
 
     NaN handling: a traced program cannot raise, so the binning core's
@@ -382,22 +405,91 @@ def _fit_hist1_fused(
     extra on top of the sync the caller needs anyway).
     """
     binned, mids, nan_flag = binning.device_binning_core(Xj, n_bins)
-    bins = binning.BinnedFeatures(
-        binned=binned, thresholds=mids.T,
-        n_bins=np.full(Xj.shape[1], n_bins, np.int32),
+    if n_bins <= 256:
+        # the only O(n·F) array each stage reads — keep it one byte wide
+        binned = binned.astype(jnp.uint8)
+    thresholds = mids.T                                      # [F, B-1]
+    dtype = thresholds.dtype
+    n, F = Xj.shape
+    # Static left-of-boundary counts (one pass, loop-invariant): same
+    # compare+sum as the sorted layout's left_count — bin B-1 exceeds every
+    # boundary, so chunk padding is reduction-neutral by construction.
+    boundaries = jnp.arange(n_bins - 1, dtype=jnp.int32)
+    mapped, _ = binning.chunked_row_reduce(
+        binned.astype(jnp.int32),
+        lambda bc: jnp.sum(
+            bc[:, None, :] <= boundaries[None, :, None],
+            axis=0, dtype=jnp.int32,
+        ),
+        pad_value=n_bins - 1,
     )
-    # Labels ride the layout's row gather as a packed bin column — valid
-    # only for exact-0/1 labels, so fold the check into the bad-input flag
-    # (binomial deviance requires binary labels anyway).
-    sd = histogram.build_stump_data_device(bins, yj, assume_binary_y=True)
-    feature, threshold, value, is_split, deviance = _fit_stumps(
-        sd,
-        n_stages=n_stages,
-        learning_rate=learning_rate,
-        min_samples_split=min_samples_split,
-        min_samples_leaf=min_samples_leaf,
+    left_count = jnp.sum(mapped, axis=0).T                   # [F, B-1]
+
+    ys = yj.astype(dtype)
+    f0 = _prior_log_odds(ys)  # the one copy of the init-score formula
+    CL = left_count.astype(dtype)[None]                      # [1, F, B-1]
+    CT = jnp.asarray([n], dtype)
+
+    carry = (
+        jnp.full((n,), f0, dtype),
+        jnp.zeros((n_stages, 3), jnp.int32),
+        jnp.full((n_stages, 3), jnp.inf, dtype),
+        jnp.zeros((n_stages, 3), dtype),
+        jnp.zeros((n_stages, 3), bool),
+        jnp.zeros(n_stages, dtype),
     )
-    f0 = _prior_log_odds(yj)
+
+    def stage(t, carry):
+        raw, feats, thrs, vals, splits, devs = carry         # raw: [n]
+        p = jax.scipy.special.expit(raw)
+        g = ys - p
+        h = p * (1.0 - p)
+        hist = histogram.stump_histograms(
+            binned, g, h, n_bins, backend=backend
+        )                                                    # [2, F, B]
+        GL = jnp.cumsum(hist[0], axis=1)[:, :-1][None]       # [1, F, B-1]
+        HL = jnp.cumsum(hist[1], axis=1)[:, :-1]             # [F, B-1]
+        GT = jnp.sum(g)
+        HT = jnp.sum(h)
+        sp = histogram.select_splits(
+            GL, CL, GT[None], CT, jnp.sum(g * g)[None], thresholds,
+            min_samples_split, min_samples_leaf,
+        )
+        do = sp.do_split[0]
+        fstar, bstar = sp.feature[0], sp.boundary[0]
+        num_l = GL[0, fstar, bstar]
+        den_l = HL[fstar, bstar]
+        num_r, den_r = GT - num_l, HT - den_l
+
+        newton = histogram.newton_leaf_value
+        v_root = newton(GT, HT)  # unsplit stage: single-leaf Newton value
+        v_l, v_r = newton(num_l, den_l), newton(num_r, den_r)
+
+        split_bins = jax.lax.dynamic_index_in_dim(
+            binned, fstar, axis=1, keepdims=False
+        )                                                    # [n]
+        go_left = split_bins <= bstar.astype(split_bins.dtype)
+        contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
+        raw = raw + learning_rate * contrib
+        dev = -2.0 * jnp.mean(ys * raw - jnp.logaddexp(0.0, raw))
+
+        feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)
+        thr_t = jnp.stack([jnp.where(do, sp.threshold[0], jnp.inf),
+                           jnp.array(jnp.inf, dtype), jnp.array(jnp.inf, dtype)])
+        val_t = jnp.stack([jnp.where(do, 0.0, v_root),
+                           jnp.where(do, v_l, 0.0), jnp.where(do, v_r, 0.0)])
+        split_t = jnp.stack([do, jnp.array(False), jnp.array(False)])
+        return (
+            raw,
+            feats.at[t].set(feat_t),
+            thrs.at[t].set(thr_t.astype(dtype)),
+            vals.at[t].set(val_t.astype(dtype)),
+            splits.at[t].set(split_t),
+            devs.at[t].set(dev),
+        )
+
+    carry = jax.lax.fori_loop(0, n_stages, stage, carry)
+    _, feature, threshold, value, is_split, deviance = carry
     nonbin_flag = ~histogram.is_binary_labels(yj)
     # One scalar status ships both conditions (each bool() fetch is a full
     # host round trip on a tunneled backend): bit 1 = NaN input, bit 0 =

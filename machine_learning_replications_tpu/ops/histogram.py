@@ -384,6 +384,85 @@ def node_histograms_matmul(
     return NodeHistograms(grad=out[0], hess=out[1], grad2=out[2], count=out[3])
 
 
+def stump_histograms(
+    binned: jnp.ndarray,  # [n, F] integer bin ids (narrow dtype preserved)
+    grad: jnp.ndarray,    # [n]
+    hess: jnp.ndarray,    # [n]
+    max_bins: int,
+    backend: str = "xla",
+    chunk: int = 8192,
+) -> jnp.ndarray:
+    """Root-node (K=1) gradient/hessian histograms → ``[2, F, B]``.
+
+    The per-stage statistics pass of the UNSORTED depth-1 formulation:
+    ``out[0, f, b] = Σ_i grad[i]·[binned[i, f] == b]`` and likewise for
+    hess. Cumulative sums of these over bins reproduce the sorted layout's
+    boundary sums exactly up to f32 regrouping — the r5 trace showed the
+    sorted path spending ~70% of each stage on pad/reshape/copy data
+    formatting (docs/SCALING.md "Roofline"), which this formulation has
+    none of: per stage it reads the (loop-invariant, u8) bin matrix plus
+    O(n) vectors.
+
+    Backends mirror ``node_histograms*``: 'xla' → two segment_sums
+    (compiled scatter-adds, the CPU pick); 'matmul' → chunked one-hot MXU
+    contraction (dense ``[2, c] × [c, B]`` per feature, f32-HIGHEST);
+    'pallas' → the VMEM-accumulating kernel (``stump_histograms_pallas``).
+    """
+    n, F = binned.shape
+    B = max_bins
+    dtype = jnp.result_type(grad.dtype, jnp.float32)
+    if backend == "pallas":
+        from machine_learning_replications_tpu.ops.pallas_histogram import (
+            stump_histograms_pallas,
+        )
+
+        return stump_histograms_pallas(binned, grad, hess, B)
+    if backend == "xla":
+        seg = (
+            jnp.arange(F, dtype=jnp.int32)[None, :] * B
+            + binned.astype(jnp.int32)
+        ).reshape(-1)
+
+        def acc(v):
+            flat = jnp.broadcast_to(v[:, None], (n, F)).reshape(-1)
+            return jax.ops.segment_sum(flat, seg, num_segments=F * B)
+
+        return jnp.stack(
+            [acc(grad.astype(dtype)), acc(hess.astype(dtype))]
+        ).reshape(2, F, B)
+    if backend != "matmul":
+        raise ValueError(f"unknown stump histogram backend {backend!r}")
+
+    n_pad = -(-n // chunk) * chunk
+    stats = jnp.stack([grad.astype(dtype), hess.astype(dtype)], axis=0)
+    stats = jnp.pad(stats, ((0, 0), (0, n_pad - n)))
+    # pad rows carry zero stats; their bin id (0) contributes nothing
+    binned_p = jnp.pad(binned, ((0, n_pad - n), (0, 0)))
+
+    def body(acc, args):
+        stats_c, bins_c = args  # [2, c], [c, F]
+        bins_i = bins_c.astype(jnp.int32)
+        cols = jnp.arange(B, dtype=jnp.int32)
+        parts = []
+        for f in range(F):
+            onehot_f = (bins_i[:, f][:, None] == cols).astype(dtype)
+            parts.append(jax.lax.dot(
+                stats_c, onehot_f, precision=jax.lax.Precision.HIGHEST
+            ))  # [2, B]
+        return acc + jnp.stack(parts, axis=1), None
+
+    acc0 = jnp.zeros((2, F, B), dtype)
+    out, _ = jax.lax.scan(
+        body,
+        acc0,
+        (
+            stats.reshape(2, n_pad // chunk, chunk).transpose(1, 0, 2),
+            binned_p.reshape(n_pad // chunk, chunk, F),
+        ),
+    )
+    return out
+
+
 def select_splits(
     GL: jnp.ndarray,          # [K, F, B-1] left-of-boundary residual sums
     CL: jnp.ndarray,          # [K, F, B-1] left-of-boundary counts

@@ -49,20 +49,23 @@ def _row_block(kb: int, itemsize: int) -> int:
 def _histogram_kernel(binned_ref, seg_ref, vals_ref, out_ref, *, n_feat, kb):
     """One row block: per feature, one-hot (node,bin) cells and contract.
 
-    binned_ref: [R, F] int32 — bin ids
+    binned_ref: [R, F] integer bin ids (any width; widened in-register)
     seg_ref:    [R, 1] int32 — node·B offset (clamped; inactive rows have
                 zeroed vals so their cell contribution vanishes)
-    vals_ref:   [R, 4] — (grad, hess, grad², active) per row
-    out_ref:    [4, F, K·B] — accumulated across the row-block grid
+    vals_ref:   [R, S] — per-row statistics (S is static; the node path
+                stacks (grad, hess, grad², active), the stump path only
+                (grad, hess))
+    out_ref:    [S, F, K·B] — accumulated across the row-block grid
     """
     step = pl.program_id(0)
-    vals = vals_ref[:]                                   # [R, 4]
+    vals = vals_ref[:]                                   # [R, S]
     dtype = vals.dtype
-    col = jax.lax.broadcasted_iota(jnp.int32, (binned_ref.shape[0], kb), 1)
+    bb = binned_ref[:].astype(jnp.int32)                 # [R, F]
+    col = jax.lax.broadcasted_iota(jnp.int32, (bb.shape[0], kb), 1)
     node_off = seg_ref[:]                                # [R, 1]
     partials = []
     for f in range(n_feat):
-        seg_f = node_off + binned_ref[:, f][:, None]     # [R, 1]
+        seg_f = node_off + bb[:, f][:, None]             # [R, 1]
         onehot = (seg_f == col).astype(dtype)            # [R, K·B]
         partials.append(jax.lax.dot_general(
             vals, onehot,
@@ -72,8 +75,8 @@ def _histogram_kernel(binned_ref, seg_ref, vals_ref, out_ref, *, n_feat, kb):
             # accumulated statistics at f32 precision (a single bf16 MXU
             # pass costs ~3 decimal digits on the sums).
             precision=jax.lax.Precision.HIGHEST,
-        ))                                               # each [4, K·B]
-    block = jnp.stack(partials, axis=1)                  # [4, F, K·B]
+        ))                                               # each [S, K·B]
+    block = jnp.stack(partials, axis=1)                  # [S, F, K·B]
 
     @pl.when(step == 0)
     def _():
@@ -86,6 +89,36 @@ def _histogram_kernel(binned_ref, seg_ref, vals_ref, out_ref, *, n_feat, kb):
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _stats_histograms(binned, seg, vals, kb: int, interpret: bool):
+    """Shared pallas_call wrapper: ``[n, F]`` bins + ``[n, 1]`` segment
+    offsets + ``[n, S]`` stats → ``[S, F, kb]`` accumulated sums. Rows are
+    padded to the adaptive block size; pad rows carry zeroed stats."""
+    n, F = binned.shape
+    S = vals.shape[1]
+    dtype = vals.dtype
+    R = _row_block(kb, jnp.dtype(dtype).itemsize)
+    n_pad = ((n + R - 1) // R) * R
+    pad = n_pad - n
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        seg = jnp.pad(seg, ((0, pad), (0, 0)))
+    return pl.pallas_call(
+        functools.partial(_histogram_kernel, n_feat=F, kb=kb),
+        grid=(n_pad // R,),
+        in_specs=[
+            pl.BlockSpec((R, F), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (S, F, kb), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, F, kb), dtype),
+        interpret=interpret,
+    )(binned, seg, vals)
 
 
 @functools.partial(
@@ -105,10 +138,7 @@ def node_histograms_pallas(
         interpret = _use_interpret()
     n, F = binned.shape
     K, B = n_nodes, max_bins
-    kb = K * B
     dtype = jnp.result_type(grad.dtype, jnp.float32)
-    R = _row_block(kb, jnp.dtype(dtype).itemsize)
-    n_pad = ((n + R - 1) // R) * R
 
     active = (node_local >= 0).astype(dtype)
     g = grad.astype(dtype) * active
@@ -116,29 +146,33 @@ def node_histograms_pallas(
     vals = jnp.stack([g, h, g * g, active], axis=1)          # [n, 4]
     seg = (jnp.maximum(node_local, 0).astype(jnp.int32) * B)[:, None]
 
-    pad = n_pad - n
-    if pad:
-        binned = jnp.pad(binned, ((0, pad), (0, 0)))
-        vals = jnp.pad(vals, ((0, pad), (0, 0)))
-        seg = jnp.pad(seg, ((0, pad), (0, 0)))
-
-    out = pl.pallas_call(
-        functools.partial(_histogram_kernel, n_feat=F, kb=kb),
-        grid=(n_pad // R,),
-        in_specs=[
-            pl.BlockSpec((R, F), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((R, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((R, 4), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (4, F, kb), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((4, F, kb), dtype),
-        interpret=interpret,
-    )(binned.astype(jnp.int32), seg, vals)
-
+    out = _stats_histograms(
+        binned.astype(jnp.int32), seg, vals, K * B, interpret
+    )
     # [4, F, K, B] → per-stat [K, F, B]
     stats = out.reshape(4, F, K, B).transpose(0, 2, 1, 3)
     return NodeHistograms(
         grad=stats[0], hess=stats[1], grad2=stats[2], count=stats[3]
     )
+
+
+@functools.partial(jax.jit, static_argnames=("max_bins", "interpret"))
+def stump_histograms_pallas(
+    binned: jnp.ndarray,  # [n, F] integer bin ids (u8 at the fused call site)
+    grad: jnp.ndarray,    # [n]
+    hess: jnp.ndarray,    # [n]
+    max_bins: int,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """K=1 specialization feeding the fused depth-1 boosting stage: only
+    the two per-stage statistics travel through the MXU (counts are static
+    per fit and Σg² is a scalar the caller reduces directly), halving the
+    contraction FLOPs vs the 4-stat node kernel. Returns ``[2, F, B]``
+    (grad, hess). ``binned`` keeps its narrow dtype end to end — at bench
+    scale the u8 bin matrix is the only O(n·F) array the stage reads."""
+    if interpret is None:
+        interpret = _use_interpret()
+    dtype = jnp.result_type(grad.dtype, jnp.float32)
+    vals = jnp.stack([grad.astype(dtype), hess.astype(dtype)], axis=1)
+    seg = jnp.zeros((binned.shape[0], 1), jnp.int32)
+    return _stats_histograms(binned, seg, vals, max_bins, interpret)

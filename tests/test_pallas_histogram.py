@@ -126,3 +126,48 @@ def test_matmul_histogram_matches_segment_sum(rng):
     )
     v = jax.vmap(lambda nd: fn(binned, nd, g, h, K, B).grad)(nodes2)
     np.testing.assert_allclose(np.asarray(v[0]), np.asarray(ref.grad))
+
+
+def test_stump_histograms_backends_agree():
+    """The fused depth-1 stage's statistics pass (K=1, two stats) must be
+    backend-independent: 'xla' (segment_sum, the CPU pick), 'matmul'
+    (chunked one-hot MXU scan) and 'pallas' (VMEM kernel, interpret mode
+    here) — the latter two are what the TPU fused path actually selects,
+    so they must not only be covered on the CPU mesh via interpret mode
+    but agree with the scatter-add oracle to summation tolerance. Also
+    pins the u8 bin-matrix dtype the fused call site uses."""
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.ops import histogram
+
+    rng = np.random.default_rng(42)
+    n, F, B = 5000, 5, 32
+    binned = jnp.asarray(rng.integers(0, B, (n, F)), jnp.uint8)
+    g = jnp.asarray(rng.normal(size=n), jnp.float32)
+    h = jnp.asarray(rng.uniform(size=n), jnp.float32)
+
+    ref = histogram.stump_histograms(binned, g, h, B, backend="xla")
+    assert ref.shape == (2, F, B)
+    # oracle: dense numpy accumulation
+    bn = np.asarray(binned)
+    want = np.zeros((2, F, B))
+    for f in range(F):
+        for stat, v in enumerate((np.asarray(g), np.asarray(h))):
+            np.add.at(want[stat, f], bn[:, f], v)
+    np.testing.assert_allclose(np.asarray(ref), want, rtol=1e-4, atol=1e-4)
+
+    got_m = histogram.stump_histograms(binned, g, h, B, backend="matmul",
+                                       chunk=512)
+    np.testing.assert_allclose(
+        np.asarray(got_m), np.asarray(ref), rtol=1e-5, atol=1e-5,
+        err_msg="matmul",
+    )
+    from machine_learning_replications_tpu.ops.pallas_histogram import (
+        stump_histograms_pallas,
+    )
+
+    got_p = stump_histograms_pallas(binned, g, h, B)
+    np.testing.assert_allclose(
+        np.asarray(got_p), np.asarray(ref), rtol=1e-5, atol=1e-5,
+        err_msg="pallas",
+    )
