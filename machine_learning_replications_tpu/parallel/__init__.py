@@ -28,11 +28,13 @@ from machine_learning_replications_tpu.parallel import (
 
 def fit_gbdt_sharded(mesh, X, y, cfg, sample_weight=None, bins=None):
     """Mesh-sharded GBDT fit, dispatching like ``models.gbdt.fit``: the
-    replicated-sorted stump trainer at depth 1 (rows over 'data', feature
-    tiles over 'model' — dense per-stage math, no gathers), the level-wise
-    histogram trainer at depth ≥ 2 (per-level psum'd partials), or as the
-    depth-1 fallback when the sorted layout would blow the per-shard memory
-    budget. Returns (params, aux)."""
+    histogram stump trainer at depth 1 (rows over 'data', feature tiles
+    over 'model' — per-stage grad/hess histogram partials psum'd over
+    ICI), the level-wise histogram trainer at depth ≥ 2 (per-level psum'd
+    partials), or as the depth-1 fallback when the stump trainer's
+    per-shard working set would blow the memory budget (rare since the
+    r5 reformulation — the guard covers pathological meshes).
+    Returns (params, aux)."""
     from machine_learning_replications_tpu.models import gbdt as _gbdt
 
     if bins is None:

@@ -15,8 +15,9 @@ level-synchronously —
 Leaf Newton values come from a psum'd segment-sum over final node ids, and
 the deviance from psum'd log-likelihood partials — nothing crosses the host
 boundary inside the stage loop. The 'model' axis is left replicated here
-(feature tiling pays off only in the stump layout — ``stump_trainer``);
-outputs are replicated on every shard by construction.
+(feature tiling pays off only in the depth-1 stump trainer's per-tile
+histogram/scoring split — ``stump_trainer``); outputs are replicated on
+every shard by construction.
 
 Padding contract: rows appended to even out shards carry weight 0 and node
 −1 forever; their gradients are zeroed so every reduction ignores them.

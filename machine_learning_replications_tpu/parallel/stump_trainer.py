@@ -1,32 +1,37 @@
-"""Distributed depth-1 GBDT training — the sharded version of the
-replicated-sorted-layout trainer (``models.gbdt._fit_stumps``).
+"""Distributed depth-1 GBDT training — the sharded counterpart of the
+fused unsorted-histogram trainer (``models.gbdt._fit_hist1_fused``).
 
 Mesh mapping (SURVEY.md §2.5 — promoting the reference's implicit axes):
 
-  data  — cohort rows. Each shard holds its own rows in *locally* sorted
-          order per feature; cumulative left-of-boundary sums are additive
-          across shards, so the only per-stage communication is a ``psum``
-          of ``[F, B-1]`` gradient/hessian partials (plus five scalars) over
-          ICI. This is the "histogram partials all-reduced" design.
-  model — feature tiles of the split search: each shard owns the sorted
-          copies of F/model features and scores their candidate splits; the
-          global argmax is recovered with one tiny ``all_gather`` of
-          per-shard bests. Split routing needs the *chosen* feature's bins
-          in every local sort order, which is why ``bins_x`` keeps its
-          query-feature axis unsharded.
+  data  — cohort rows. Each shard accumulates gradient/hessian histograms
+          of its local rows (``ops.histogram.stump_histograms`` — one-hot
+          MXU contraction / Pallas VMEM kernel on TPU), and the only
+          per-stage communication is a ``psum`` of ``[F_loc, B]`` partials
+          (plus five scalars) over ICI. This is the "histogram partials
+          all-reduced" design.
+  model — feature tiles of the split search: each shard histograms and
+          scores F/model features' candidate splits; the global argmax is
+          recovered with one tiny ``all_gather`` of per-shard bests. Split
+          routing reads the chosen feature's column from the (model-
+          replicated) bin matrix — a dense dynamic slice, no gathers.
 
 The whole boosting loop lives inside one ``shard_map``-ped ``jit``; nothing
-crosses the host boundary per stage.
+crosses the host boundary per stage. Until r5 this trainer sharded the
+replicated-sorted layout (F copies of every row vector per shard, boundary
+sums per stage); the trace read in docs/SCALING.md "Roofline" showed ~70%
+of each on-chip stage going to that layout's pad/reshape/copy formatting,
+and its ``[F, F, n_local]`` bin tensor (2.9 GB at 10M rows on one shard)
+dominated HBM. The histogram formulation keeps one ``[n_local]`` score
+vector and the ``[n_local, F]`` u8 bin matrix — O(F·n/S) memory, same
+math up to f32 summation regrouping.
 
 Padding contracts: rows padded per shard carry weight 0 and bin ``B-1``
-(they sort past every candidate boundary, and all their sums are masked);
-feature *sort-order slots* padded to a multiple of the model-axis size are
-coherent identity-order copies of the real data with +inf thresholds — they
-evolve the same raw scores as real slots but can never be selected, so every
-shard (including shards owning only padded slots) computes identical
-replicated outputs. Global scalar reductions additionally come from model
-shard 0 only (masked two-axis psum), making replication hold by
-construction rather than by the padding argument.
+(the weighted path zeroes their statistics; the final bin never enters a
+left-of-boundary sum); feature slots padded to a multiple of the
+model-axis size hold constant-0 bins with +inf thresholds, so their
+candidates are permanently invalid on every shard. Global scalar
+reductions come from model shard 0 only (masked two-axis psum), making
+replication hold by construction rather than by the padding argument.
 """
 
 from __future__ import annotations
@@ -51,30 +56,33 @@ from machine_learning_replications_tpu.ops.histogram import (  # noqa: E402
 )
 
 
-# Per-shard budget for the replicated-sorted layout (``bins_x`` is the
-# dominant allocation: F_pad · F_loc · n_local bin ids per (data, model)
-# shard — O(F²·n/S) memory). Above this the trainer refuses with sizing
-# advice instead of OOM-ing mid-compile (VERDICT r2 weak #5).
+# Per-shard budget for the trainer's working set. Since the r5 histogram
+# reformulation the dominant allocation is just the model-replicated bin
+# matrix (``n_local · F_pad`` bin ids) plus a handful of [n_local] f32
+# vectors — O(F·n/S), ~40× under the old sorted layout's O(F²·n/S) — so
+# this guard exists for pathological meshes/cardinalities, not routine
+# fits. Above it the trainer refuses with sizing advice instead of
+# OOM-ing mid-compile (VERDICT r2 weak #5).
 MAX_LAYOUT_BYTES = 8 << 30
 
 
 def _layout_plan(n: int, F: int, max_bins: int, n_data: int, n_model: int):
-    """(F_pad, n_local, bin_dtype, bins_x bytes per shard) for a mesh shape.
-
-    The byte estimate counts F_pad+1 gathered planes: binary labels ride
-    the bins matrix as one extra packed column (``_fit_raw``), and the
-    guard must be conservative for exactly the configuration that
-    allocates the most — an unpacked fit simply comes in ~1/F_pad under
-    the estimate."""
+    """(F_pad, n_local, bin_dtype, working-set bytes per shard) for a mesh
+    shape. Conservative for the backend that allocates the most: the
+    'xla' stump_histograms engine materializes an int32 segment id plus a
+    broadcast f64 scatter operand over the [n_local, F_loc] tile per
+    stage (~24 B/element of transient), on top of the model-replicated
+    bin matrix and the ~6 per-row f32/f64 vectors each stage touches."""
     F_pad = -(-F // n_model) * n_model
     n_local = -(-n // n_data)
+    F_loc = F_pad // n_model
     bin_dtype = (
         np.uint8 if max_bins <= 256
         else np.uint16 if max_bins <= 65536
         else np.int32
     )
-    per_shard = (
-        (F_pad + 1) * (F_pad // n_model) * n_local * np.dtype(bin_dtype).itemsize
+    per_shard = n_local * (
+        F_pad * np.dtype(bin_dtype).itemsize + F_loc * 24 + 6 * 8
     )
     return F_pad, n_local, bin_dtype, per_shard
 
@@ -88,10 +96,8 @@ def _fit_raw(
     sample_weight: np.ndarray | None = None,
     max_layout_bytes: int | None = None,
 ):
-    """Pad + place the binned cohort on the mesh and run the sharded loop
-    (the sorted-layout build itself happens on device, inside the
-    ``shard_map`` — the host prep loop it replaces cost more than the whole
-    boosting loop at bench scale). Returns the raw replicated device arrays
+    """Pad + place the binned cohort on the mesh and run the sharded loop.
+    Returns the raw replicated device arrays
     ``(feats, thrs, vals, splits, devs)``."""
     assert cfg.max_depth == 1, "sharded trainer covers the depth-1 config"
     if bins is None:
@@ -104,21 +110,19 @@ def _fit_raw(
     budget = MAX_LAYOUT_BYTES if max_layout_bytes is None else max_layout_bytes
     if per_shard > budget:
         raise RuntimeError(
-            f"stump_trainer: replicated-sorted layout needs {per_shard:,} bytes "
-            f"per shard (F={F}, n_local={n_local}, max_bins={B}, "
+            f"stump_trainer: per-shard working set needs {per_shard:,} bytes "
+            f"(F={F}, n_local={n_local}, max_bins={B}, "
             f"bin dtype {np.dtype(bin_dtype).name}) > budget {budget:,} bytes. "
             "Add data shards to the mesh, use splitter='hist' (n_bins<=256 "
-            "makes bin ids uint8), or route through parallel.hist_trainer "
-            "(O(n/S) memory, no sorted layout)."
+            "makes bin ids uint8), or route through parallel.hist_trainer."
         )
 
     import jax.numpy as jnp
 
-    # Device-side padding: rows pad to n_data·n_local with bin B-1 / weight 0
-    # (they sort past every boundary and all their sums are masked); feature
-    # columns pad to F_pad with constant 0 bins, whose stable argsort is the
-    # identity — the "coherent identity-order copy" the padded sort slots
-    # need, with +inf thresholds making their candidates permanently invalid.
+    # Device-side padding: rows pad to n_data·n_local with bin B-1 / weight
+    # 0 (zero-weighted statistics; B-1 never enters a left-of-boundary
+    # sum); feature columns pad to F_pad with constant 0 bins and +inf
+    # thresholds, making their candidates permanently invalid.
     n_pad = n_data * n_local
     bj = jnp.asarray(bins.binned).astype(bin_dtype)
     bl_ext = jnp.pad(
@@ -126,18 +130,6 @@ def _fit_raw(
     )
     bl_ext = jnp.pad(bl_ext, ((0, 0), (0, F_pad - F)))
     fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
-    # Exact-0/1 labels ride the bins matrix as one extra packed column, so
-    # each shard recovers them from the layout's existing row gather
-    # instead of a separate scattered gather per sort order (~20% of the
-    # layout wall at 10M rows). Host labels are checked here; device
-    # labels cost one scalar fetch — still far cheaper than the gather.
-    from machine_learning_replications_tpu.ops.histogram import is_binary_labels
-
-    yj = jnp.asarray(y)
-    binary_y = bool(is_binary_labels(y if isinstance(y, np.ndarray) else yj))
-    if binary_y:
-        ybit = jnp.pad((yj > 0.5).astype(bin_dtype), (0, n_pad - n))
-        bl_ext = jnp.concatenate([bl_ext, ybit[:, None]], axis=1)
     # Uniform weights + no padding rows ⇒ the weighted machinery is dead
     # code inside the loop (see ``weighted=`` below); don't build and ship
     # a full-length all-ones array the program never reads — at 10M rows
@@ -161,10 +153,8 @@ def _fit_raw(
     def put(a, spec):
         return jax.device_put(a, NamedSharding(mesh, spec))
 
-    if binary_y:
-        y_pad = jnp.zeros(n_data, fdt)  # dead operand; labels ride bl_ext
-    else:
-        y_pad = jnp.pad(yj.astype(fdt), (0, n_pad - n))
+    yj = jnp.asarray(y)
+    y_pad = jnp.pad(yj.astype(fdt), (0, n_pad - n))
     return _fit_sharded(
         mesh,
         put(bl_ext, P(DATA_AXIS, None)),
@@ -176,7 +166,8 @@ def _fit_raw(
         min_samples_leaf=cfg.min_samples_leaf,
         min_samples_split=cfg.min_samples_split,
         weighted=weighted,
-        y_in_bins=binary_y,
+        max_bins=B,
+        backend=gbdt.resolve_backend(cfg),
     )
 
 
@@ -221,13 +212,13 @@ def fit(
     jax.jit,
     static_argnames=(
         "mesh", "n_stages", "learning_rate", "min_samples_leaf",
-        "min_samples_split", "weighted", "y_in_bins",
+        "min_samples_split", "weighted", "max_bins", "backend",
     ),
 )
 def _fit_sharded(
     mesh,
     bl_ext,      # [n_pad, F_pad] bin ids, rows sharded over 'data' (model-
-                 #   replicated: every model shard sorts its own column tile)
+                 #   replicated: every model shard histograms its column tile)
     y_pad,       # [n_pad] — labels, 0 at padding rows
     w_pad,       # [n_pad] — sample weights, 0 at padding rows
     thresholds,  # [F_pad, B-1] replicated (+inf on padded feature slots)
@@ -237,13 +228,14 @@ def _fit_sharded(
     min_samples_leaf: int,
     min_samples_split: int,
     weighted: bool = True,
-    y_in_bins: bool = False,
+    max_bins: int = 256,
+    backend: str = "xla",
 ):
     from jax import shard_map
 
     Bm1 = thresholds.shape[-1]
     n_model = mesh.shape[MODEL_AXIS]
-    F_pad = bl_ext.shape[1] - (1 if y_in_bins else 0)
+    F_pad = bl_ext.shape[1]
     F_loc_s = F_pad // n_model
 
     def local_loop(bl, yl, wl, thr_full):
@@ -254,87 +246,72 @@ def _fit_sharded(
         m_idx = jax.lax.axis_index(MODEL_AXIS)
         on0 = m_idx == 0
 
-        # ---- device-side replicated-sorted layout for this shard --------
-        # (one-time; the stage loop below touches only dense arrays)
+        # ---- one-time per-shard prep (the stage loop touches only [n]
+        # vectors and the [n_local, F_loc] column tile) ------------------
         col0 = m_idx * F_loc_s
         thr = jax.lax.dynamic_slice_in_dim(thr_full, col0, F_loc_s, axis=0)
         cols = jax.lax.dynamic_slice_in_dim(bl, col0, F_loc_s, axis=1)
-        order = jnp.argsort(cols, axis=0, stable=True)       # [n_local, F_loc]
-        # bx[fq, fs, i] = bl[order[i, fs], fq] — every feature's bins in
-        # every local sort order (split routing is a dense compare).
-        bx = jnp.transpose(bl[order.T, :], (2, 0, 1))  # [F_pad(+1), F_loc, n]
-        if y_in_bins:
-            # Labels came along as bl's last column — already in every
-            # local sort order via the row gather above.
-            ys = bx[F_pad].astype(dtype)                      # [F_loc, n_local]
-        else:
-            ys = jnp.take_along_axis(
-                jnp.broadcast_to(yl[None, :], order.T.shape), order.T, axis=1
-            ).astype(dtype)                                   # [F_loc, n_local]
-        if weighted:
-            ws = jnp.take_along_axis(
-                jnp.broadcast_to(wl[None, :], order.T.shape), order.T, axis=1
-            ).astype(dtype)
-        else:
-            # No sample weights and no padding rows (n_pad == n, checked by
-            # the caller): the ws layout gather (~17M scattered reads at
-            # 10M rows) and the two per-stage [F, n] mask multiplies are
-            # pure overhead — every row is real with weight 1.
-            ws = None
-        # Positional prefix boundaries: #rows with bin ≤ b, from a chunked
-        # compare+sum histogram over the UNSORTED local columns — the old
-        # sorted-gather + vmapped searchsorted lowered to serialized
-        # dynamic gathers (the same pathology ops.binning documents).
-        # Padding rows carry bin B-1 > every boundary so they never count;
-        # a padded feature slot's constant-0 column gives lc = n_local,
-        # which its +inf thresholds make unreachable (valid=False).
-        bvals = jnp.arange(Bm1, dtype=cols.dtype)
-        lc_mapped, _ = binning.chunked_row_reduce(
-            cols,
-            lambda cc: jnp.sum(
-                cc[:, None, :] <= bvals[None, :, None], axis=0, dtype=jnp.int32
-            ),
-            pad_value=np.asarray(Bm1, cols.dtype),
-        )
-        lc = jnp.sum(lc_mapped, axis=0).T.astype(jnp.int32)   # [F_loc, B-1]
+        ys = yl.astype(dtype)                                 # [n_local]
+        ws = wl.astype(dtype) if weighted else None
         F_loc = F_loc_s
-        # NOTE: the stage loop below deliberately keeps a FLAT [F_loc,
-        # n_local] carry and pays cumulative_boundary_sums' internal
-        # pad+reshape per stage — the block-resident alternative was
-        # ablated on v5e in r3: zero runtime gain and an O(n) compile
-        # blowup when a large pad+reshape feeds a while loop
-        # (docs/SCALING.md "Lowerings"; memory note tpu-stump-loop-floor).
         from machine_learning_replications_tpu.ops import histogram as hist_ops
 
         def gsum(v):
             """Global Σ over real rows of a per-row [n_local] quantity, taken
-            from model shard 0's slot-0 ordering and psum'd over BOTH axes —
-            replicated on every shard by construction."""
+            from model shard 0 and psum'd over BOTH axes — replicated on
+            every shard by construction."""
             return jax.lax.psum(
                 jnp.where(on0, jnp.sum(v), 0.0).astype(dtype),
                 (DATA_AXIS, MODEL_AXIS),
             )
 
         if weighted:
-            n_real = gsum(ws[0])  # rows are real ⇔ w=1
-            sum_y = gsum(ys[0] * ws[0])
+            n_real = gsum(ws)  # rows are real ⇔ w=1
+            sum_y = gsum(ys * ws)
         else:
-            n_real = gsum(jnp.ones_like(ys[0]))
-            sum_y = gsum(ys[0])
+            n_real = gsum(jnp.ones_like(ys))
+            sum_y = gsum(ys)
         p1 = sum_y / n_real
         f0 = jnp.log(p1 / (1.0 - p1))
 
-        def cumb(v):  # [F_loc, n_local] → global left-of-boundary sums [F_loc, B-1]
-            return jax.lax.psum(hist_ops.cumulative_boundary_sums(v, lc), DATA_AXIS)
+        def hist_cum(g, h):
+            """Per-stage global left-of-boundary grad/hess sums
+            [2, F_loc, B-1]: local histograms over this shard's column tile
+            (``stump_histograms`` — the same engine the fused single-device
+            path uses), one psum of the [2, F_loc, B] partials over 'data',
+            then a tiny cumsum over bins."""
+            hg = hist_ops.stump_histograms(
+                cols, g, h, max_bins, backend=backend
+            )                                                 # [2, F_loc, B]
+            hg = jax.lax.psum(hg, DATA_AXIS)
+            return jnp.cumsum(hg, axis=2)[:, :, :Bm1]
 
         if weighted:
-            CL = cumb(ws)  # weights don't change: hoisted out of the loop
+            # weights don't change: hoisted out of the loop (one extra
+            # histogram pass at fit start)
+            ones = jnp.ones_like(ys)
+            CL = hist_cum(ws, ones)[0]
         else:
-            # Unweighted counts are exactly the positional boundaries.
+            # Unweighted counts are exactly the positional boundaries:
+            # #rows with bin ≤ b via a chunked compare+sum over the
+            # (unsorted) local columns. Padding rows carry bin B-1 > every
+            # boundary so they never count; a padded feature slot's
+            # constant-0 column gives lc = n_local, which its +inf
+            # thresholds make unreachable (valid=False).
+            bvals = jnp.arange(Bm1, dtype=cols.dtype)
+            lc_mapped, _ = binning.chunked_row_reduce(
+                cols,
+                lambda cc: jnp.sum(
+                    cc[:, None, :] <= bvals[None, :, None],
+                    axis=0, dtype=jnp.int32,
+                ),
+                pad_value=np.asarray(Bm1, cols.dtype),
+            )
+            lc = jnp.sum(lc_mapped, axis=0).T.astype(jnp.int32)
             CL = jax.lax.psum(lc.astype(dtype), DATA_AXIS)
 
         def stage(t, carry):
-            raw, feats, thrs_o, vals, splits, devs = carry  # raw [F_loc, n_local]
+            raw, feats, thrs_o, vals, splits, devs = carry    # raw [n_local]
             p = jax.scipy.special.expit(raw)
             if weighted:
                 g = (ys - p) * ws
@@ -342,11 +319,11 @@ def _fit_sharded(
             else:
                 g = ys - p
                 h = p * (1.0 - p)
-            GL = cumb(g)
-            HL = cumb(h)
-            GT = gsum(g[0])
-            HT = gsum(h[0])
-            G2 = gsum(g[0] * g[0])
+            GHL = hist_cum(g, h)
+            GL, HL = GHL[0], GHL[1]
+            GT = gsum(g)
+            HT = gsum(h)
+            G2 = gsum(g * g)
 
             # local split scoring over this shard's features
             GR = GT - GL
@@ -398,14 +375,14 @@ def _fit_sharded(
             v_r = newton_leaf_value(num_r, den_r)
 
             split_bins = jax.lax.dynamic_index_in_dim(
-                bx, fstar, axis=0, keepdims=False
-            )  # [F_loc, n_local]
+                bl, fstar, axis=1, keepdims=False
+            )  # [n_local] — the chosen feature's column, model-replicated
             go_left = split_bins <= bstar.astype(split_bins.dtype)
             contrib = jnp.where(do, jnp.where(go_left, v_l, v_r), v_root)
             raw = raw + learning_rate * contrib
 
-            ll_terms = ys[0] * raw[0] - jnp.logaddexp(0.0, raw[0])
-            ll = gsum(ll_terms * ws[0] if weighted else ll_terms)
+            ll_terms = ys * raw - jnp.logaddexp(0.0, raw)
+            ll = gsum(ll_terms * ws if weighted else ll_terms)
             dev = -2.0 * ll / n_real
 
             feat_t = jnp.where(do, fstar, 0) * jnp.array([1, 0, 0], jnp.int32)
@@ -428,7 +405,7 @@ def _fit_sharded(
             )
 
         init = (
-            jnp.full((F_loc, n_local), f0, dtype),
+            jnp.full((n_local,), f0, dtype),
             jnp.zeros((n_stages, 3), jnp.int32),
             jnp.full((n_stages, 3), jnp.inf, dtype),
             jnp.zeros((n_stages, 3), dtype),
