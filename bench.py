@@ -917,20 +917,22 @@ def _utilization(dev_s: float, n: int, F: int, stages: int,
     """Hardware-efficiency accounting (VERDICT r2 item 4: a speedup claim
     needs a utilization denominator). Two per-stage models:
 
-    ``mode='sorted'`` — the replicated-sorted-layout trainer (the sharded
-    config-5 path): ~6 dense passes over the ``[F, n]`` layout ⇒ ~20 flops
-    and ~33 bytes per element per stage; bandwidth-bound by design
-    (intensity ≈ 0.6 flop/byte), so hbm_util_pct is the number to watch.
-    The r5 trace read (docs/SCALING.md "Roofline") showed most of its
-    per-stage time in pad/reshape data formatting, which is why the fused
-    path moved off this design.
+    ``mode='sorted'`` — the replicated-sorted-layout trainer (now only
+    the sub-100k host-binned regimes): ~6 dense passes over the ``[F, n]``
+    layout ⇒ ~20 flops and ~33 bytes per element per stage;
+    bandwidth-bound by design (intensity ≈ 0.6 flop/byte), so
+    hbm_util_pct is the number to watch. The r5 trace read
+    (docs/SCALING.md "Roofline") showed most of its per-stage time in
+    pad/reshape data formatting, which is why the hot paths moved off
+    this design.
 
-    ``mode='hist_mxu'`` — the r5 unsorted fused path (configs 2/3 at
-    device-binning scale): per stage one u8 ``[n, F]`` bin-matrix read
-    plus ~9 ``[n]`` f32 passes ⇒ ≈ n·(F + 36) bytes, and a one-hot MXU
-    contraction of 2 stats ⇒ ≈ 4·n·F·B + 25·n flops. Intensity flips to
-    ~300 flop/byte — the stage is MXU-bound, so mfu_pct is the honest
-    gauge and hbm_util_pct the small one.
+    ``mode='hist_mxu'`` — the r5 unsorted histogram formulation (the
+    fused configs 2/3 fit AND the sharded config-5 trainer): per stage
+    one u8 ``[n, F]`` bin-matrix read plus ~9 ``[n]`` f32 passes ⇒
+    ≈ n·(F + 36) bytes, and a one-hot MXU contraction of 2 stats ⇒
+    ≈ 4·n·F·B + 25·n flops. Intensity flips to ~300 flop/byte — the
+    stage is MXU-bound, so mfu_pct is the honest gauge and hbm_util_pct
+    the small one.
     """
     import jax
 
@@ -979,17 +981,27 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
     # handing a device array to the host-binning regimes (exact splitter,
     # small rows) would make every timed repeat pull X back through the
     # same slow link instead.
-    if cfg.splitter == "hist" and args.rows >= gbdt.DEVICE_BINNING_MIN_ROWS:
+    # fit() routes one-shot stumps (n_estimators=1 at device-binning
+    # scale) through the threaded host engine (gbdt._fit_stump_host): no
+    # XLA compile, no h2d of a 68 MB matrix through the tunnel for
+    # ~0.5 s of work, and no device in the loop at all — the leg must
+    # keep X host-resident AND report the engine honestly below.
+    host_stump = n_estimators == 1 and gbdt.uses_fused_hist1(cfg, args.rows)
+    if cfg.splitter == "hist" and args.rows >= gbdt.DEVICE_BINNING_MIN_ROWS \
+            and not host_stump:
         with timer.phase("h2d_transfer") as ph:
             X17_d = ph.block(jax.device_put(jnp.asarray(X17)))
             yf_d = ph.block(jax.device_put(jnp.asarray(yf)))
     else:
         X17_d, yf_d = X17, yf
-    # Recorded for the phase breakdown only — the timed fit below re-bins
-    # from scratch so the measurement covers the same end-to-end work as
-    # the sklearn baseline's fit() (which includes its presort).
-    with timer.phase("binning") as ph:
-        ph.block(gbdt.default_bins(X17_d, cfg).binned)
+    if not host_stump:
+        # Recorded for the phase breakdown only — the timed fit below
+        # re-bins from scratch so the measurement covers the same
+        # end-to-end work as the sklearn baseline's fit() (which includes
+        # its presort). The host-stump leg skips this: its fit derives
+        # candidates itself and never touches the device.
+        with timer.phase("binning") as ph:
+            ph.block(gbdt.default_bins(X17_d, cfg).binned)
 
     holder = {}
 
@@ -1018,20 +1030,27 @@ def device_leg_gbdt(args, n_estimators: int) -> dict:
         "unit": "s",
         "auc": auc,
         "splitter": args.splitter,
-        "device": _device_kind(),
+        # the host-stump engine never touches the accelerator: the device
+        # column must say so, and chip-peak utilization would be fiction
+        "device": "host:numpy_stump" if host_stump else _device_kind(),
         "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
-        **_utilization(
+    }
+    if not host_stump:
+        rec.update(_utilization(
             dev_s, args.rows, X17.shape[1], n_estimators,
             # same predicate fit() uses to pick the fused unsorted path
             mode=("hist_mxu" if gbdt.uses_fused_hist1(cfg, args.rows)
                   else "sorted"),
             n_bins=cfg.n_bins,
-        ),
-    }
+        ))
+    else:
+        rec["engine"] = (
+            "host numpy single-stump (gbdt._fit_stump_host): one-shot "
+            "fits skip XLA entirely, so cold == warm — no compile wall"
+        )
     if n_estimators == 1 and cold_s > 5 * dev_s:
-        # Config 2's wall is one-time trace+compile by construction: a
-        # single-stump fit does the same binning as the 100-stump program
-        # but amortizes the compile over 1/100th of the device work.
+        # Legacy device-path regime note (only reachable if the host
+        # engine is bypassed): the wall is one-time trace+compile.
         rec["compile_bound"] = True
         rec["marginal_stage_s"] = round(dev_s, 4)
         rec["note_compile"] = (
@@ -1205,7 +1224,10 @@ def device_leg_scaled(args) -> dict:
         "throughput_rows_per_s": round((rows - holdout) / dev_s, 1),
         "device": _device_kind(),
         "phases_s": {k: round(v, 4) for k, v in timer.seconds.items()},
-        **_utilization(dev_s, rows - holdout, X17.shape[1], cfg.n_estimators),
+        # r5: the sharded stump trainer uses the same unsorted histogram
+        # stage as the fused single-device path
+        **_utilization(dev_s, rows - holdout, X17.shape[1], cfg.n_estimators,
+                       mode="hist_mxu", n_bins=cfg.n_bins),
     }
 
 

@@ -38,6 +38,11 @@ from machine_learning_replications_tpu.models.tree import TreeEnsembleParams
 from machine_learning_replications_tpu.ops import binning, histogram
 
 
+# Host single-stump engine: quantile candidates come from a systematic
+# subsample above this many rows (quantiles stabilize long before 10^5;
+# see _fit_stump_host's docstring for the parity argument).
+_STUMP_CANDIDATE_SAMPLE = 131_072
+
 # 'hist'-mode fits at or above this row count quantize on device
 # (``binning.bin_features_device``): host ``np.unique`` binning costs more
 # than the whole boosted fit there. Below it (every parity-test regime) the
@@ -81,15 +86,30 @@ def fit(
     """
     resolve_backend(cfg)  # validate eagerly, even on paths that ignore it
     if bins is None:
+        if (
+            cfg.n_estimators == 1
+            and uses_fused_hist1(cfg, X.shape[0])
+            and isinstance(X, np.ndarray)
+        ):
+            # One-shot single-stump fits never earn their XLA compile: a
+            # fresh process pays a ~20 s trace+compile for ~0.4 s of
+            # device work (BENCH.md config-2 cold row, VERDICT r4 weak
+            # #3). At stage 0 the raw score is the constant prior, so the
+            # stump needs only a label histogram + counts per feature —
+            # host numpy, threaded over columns, with the exact
+            # device-binning candidate semantics. Device-resident inputs
+            # skip this (pulling X back through a ~18 MB/s tunnel would
+            # cost more than the compile).
+            return _fit_stump_host(X, np.asarray(y), cfg)
         if uses_fused_hist1(cfg, X.shape[0]) \
                 and not (
                     isinstance(y, np.ndarray)
                     and not histogram.is_binary_labels(y)
                 ):
-            # (host-side soft labels skip the fused path up front — its
-            # packed label column would be garbage and the post-dispatch
-            # status fallback would waste a full fit; device-resident
-            # labels keep the zero-pre-sync flag protocol below)
+            # (host-side soft labels skip the fused path up front so the
+            # post-dispatch status fallback doesn't waste a full fit;
+            # device-resident labels keep the zero-pre-sync flag protocol
+            # below)
             # Fused regime: binning + sorted layout + all boosting stages in
             # ONE jitted program. The pieces are individually cheap at this
             # scale but each separate blocking dispatch pays a full host
@@ -496,6 +516,148 @@ def _fit_hist1_fused(
     # non-binary labels.
     status = nan_flag.astype(jnp.int32) * 2 + nonbin_flag.astype(jnp.int32)
     return feature, threshold, value, is_split, deviance, f0, status
+
+
+def _fit_stump_host(
+    X: np.ndarray, y: np.ndarray, cfg: GBDTConfig
+) -> tuple[TreeEnsembleParams, dict[str, Any]]:
+    """Single-stump fit entirely in host numpy, threaded over columns.
+
+    The one-shot regime (``n_estimators=1`` at device-binning scale,
+    BASELINE config 2) cannot amortize an XLA trace+compile — ~20 s of
+    compile for ~0.4 s of device work made ``vs_baseline_cold`` 0.05
+    (BENCH.md r4). At stage 0 the raw score is the constant prior
+    ``f0``, so ``p = expit(f0) = mean(y)`` exactly, the hessian
+    ``p(1-p)`` is one scalar, and the whole split search reduces to a
+    per-feature label histogram + count histogram — no gradient vectors,
+    no device, no compile. Candidate semantics follow
+    ``binning.device_binning_core`` (empirical-quantile candidates, same
+    midpoint rounding guard, bins = ``#{mids < v}``) with two honest
+    deviations, both standard hist-GBDT practice and inside the ±0.005
+    AUC parity budget: above ``_STUMP_CANDIDATE_SAMPLE`` rows the
+    quantile candidates come from a systematic row subsample (LightGBM-
+    style — quantiles of 128k rows track quantiles of millions; only the
+    continuous columns' thresholds can shift, by less than a bin width),
+    and duplicate midpoints are deduped (a binary column keeps 1
+    candidate instead of 255 identical ones — identical partition,
+    ~8× less searchsorted work on the reference's mostly-binary
+    cohort). Selection/leaf/deviance use the same friedman proxy, Newton
+    guard, and binomial deviance formulas, accumulated in f64 — at least
+    as accurate as the device f32 sums. Columns fan out over host
+    threads (numpy releases the GIL in partition/searchsorted/bincount).
+    """
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    n, F = X.shape
+    B = cfg.n_bins
+    if np.isnan(X).any():
+        raise ValueError("input contains NaN; impute before binning")
+    fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+    y64 = np.asarray(y, np.float64)
+    p1 = float(y64.mean())
+    f0 = float(np.log(p1 / (1.0 - p1)))
+    h_const = p1 * (1.0 - p1)
+    binary_y = bool(histogram.is_binary_labels(np.asarray(y)))
+    y_bool = np.asarray(y) > 0.5 if binary_y else None
+    step = max(1, n // _STUMP_CANDIDATE_SAMPLE)
+
+    def col_stats(f):
+        col = X[:, f]
+        src = col[::step] if step > 1 else col
+        m = src.shape[0]
+        q_idx = np.round(np.linspace(0.0, 1.0, B) * (m - 1)).astype(np.int64)
+        cs = np.partition(src, q_idx)      # kth-element == full-sort[q_idx]
+        u = cs[q_idx]
+        mids = ((u[:-1] + u[1:]) / 2.0).astype(col.dtype)
+        # sklearn BestSplitter guard, as in device_binning_core: a midpoint
+        # that rounds up to the upper value would mis-route it
+        mids = np.where(mids == u[1:], u[:-1], mids)
+        mids = np.unique(mids)             # dedupe: same partition, less work
+        b = np.searchsorted(mids, col, side="left")    # == #{mids < v}
+        cnt = np.bincount(b, minlength=B).astype(np.float64)
+        if binary_y:
+            sy = np.bincount(b[y_bool], minlength=B).astype(np.float64)
+        else:
+            sy = np.bincount(b, weights=y64, minlength=B)
+        thr = np.full(B - 1, np.inf)
+        thr[: mids.shape[0]] = mids.astype(np.float64)
+        return thr, cnt, sy
+
+    workers = max(1, min(F, os.cpu_count() or 1))
+    with ThreadPoolExecutor(workers) as ex:
+        per_col = list(ex.map(col_stats, range(F)))
+    thresholds = np.stack([r[0] for r in per_col])         # [F, B-1]
+    CNT = np.stack([r[1] for r in per_col])                # [F, B]
+    SY = np.stack([r[2] for r in per_col])                 # [F, B]
+
+    # select_splits' math, f64 host edition (K=1)
+    hist_g = SY - p1 * CNT
+    GL = np.cumsum(hist_g, axis=1)[:, :-1]                 # [F, B-1]
+    CL = np.cumsum(CNT, axis=1)[:, :-1]
+    SYL = np.cumsum(SY, axis=1)[:, :-1]
+    GT = float(hist_g[0].sum())
+    HT = n * h_const
+    CR = n - CL
+    GR = GT - GL
+    valid = (
+        (CL >= cfg.min_samples_leaf)
+        & (CR >= cfg.min_samples_leaf)
+        & np.isfinite(thresholds)
+    )
+    diff = GL / np.maximum(CL, 1) - GR / np.maximum(CR, 1)
+    proxy = np.where(valid, diff * diff * CL * CR, -np.inf)
+    best = int(np.argmax(proxy))                           # flat (f, b) order
+    Bm1 = B - 1
+    fstar, bstar = best // Bm1, best % Bm1
+    best_gain = proxy[fstar, bstar]
+
+    sum_g2 = float(np.dot(y64 - p1, y64 - p1))
+    impurity = max(sum_g2 / max(n, 1) - (GT / max(n, 1)) ** 2, 0.0)
+    do = bool(
+        (n >= cfg.min_samples_split)
+        and (impurity > histogram.IMPURITY_EPS)
+        and np.isfinite(best_gain)
+    )
+
+    def newton(num, den):
+        return 0.0 if abs(den) < histogram.NEWTON_DEN_GUARD else num / den
+
+    num_l, den_l = GL[fstar, bstar], h_const * CL[fstar, bstar]
+    v_root = newton(GT, HT)
+    v_l = newton(num_l, den_l)
+    v_r = newton(GT - num_l, HT - den_l)
+
+    # binomial deviance of the updated scores — raw takes only two values
+    # (or one, unsplit), so the mean reduces to histogram aggregates
+    lr = cfg.learning_rate
+    if do:
+        n_l, sum_y_l = CL[fstar, bstar], SYL[fstar, bstar]
+        raw_l, raw_r = f0 + lr * v_l, f0 + lr * v_r
+        ll = (
+            sum_y_l * raw_l + (y64.sum() - sum_y_l) * raw_r
+            - n_l * np.logaddexp(0.0, raw_l)
+            - (n - n_l) * np.logaddexp(0.0, raw_r)
+        )
+    else:
+        raw0 = f0 + lr * v_root
+        ll = y64.sum() * raw0 - n * np.logaddexp(0.0, raw0)
+    dev = -2.0 * ll / n
+
+    feature = np.array([[fstar if do else 0, 0, 0]], np.int32)
+    thr_t = np.array(
+        [[thresholds[fstar, bstar] if do else np.inf, np.inf, np.inf]], fdt
+    )
+    value = np.array(
+        [[0.0, v_l, v_r] if do else [v_root, 0.0, 0.0]], fdt
+    )
+    is_split = np.array([[do, False, False]])
+    params = forest_to_params(
+        jnp.asarray(feature), jnp.asarray(thr_t), jnp.asarray(value),
+        jnp.asarray(is_split),
+        init_raw=np.asarray(f0, fdt), learning_rate=lr, max_depth=1,
+    )
+    return params, {"train_deviance": np.asarray([dev], fdt)}
 
 
 def _stump_init(sd: histogram.StumpData, n_stages: int):

@@ -244,11 +244,13 @@ def test_sharded_exact_high_cardinality(cohort_full):
 
 
 def test_sharded_blocked_boundary_path_equals_single_device(train_data, monkeypatch):
-    """The blocked boundary-sum decomposition (engaged above
-    ``_BLOCKED_BOUNDARY_MIN_N`` local rows — every bench-scale shard) must
-    stay semantically invisible under the psum'd sharded trainer. The
-    standard mesh tests run below the threshold, so this lowers it to force
-    the blocked path on both the single-device reference and the shards."""
+    """Cross-formulation differential: the sharded trainer's per-stage
+    histogram+cumsum statistics vs the single-device sorted path in its
+    BLOCKED boundary-sum regime (the threshold is lowered so the
+    reference takes the block decomposition — since the r5 histogram
+    reformulation the sharded side no longer calls
+    ``cumulative_boundary_sums`` at all, so this pits the two independent
+    implementations of the same sums against each other)."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     from machine_learning_replications_tpu.ops import histogram
@@ -299,10 +301,12 @@ def test_nonbinary_labels_use_gather_fallback(train_data):
 
 
 def test_sharded_blocked_weighted_path_equals_subset(train_data, monkeypatch):
-    """Blocked-regime coverage for the WEIGHTED sharded loop (the
-    unweighted blocked test above leaves the per-stage weighted sums — CL
-    hoisting, zero-weight padding rows — unexercised). Must still equal
-    the single-device fit on the physical subset."""
+    """WEIGHTED-loop coverage of the same cross-formulation differential
+    (the unweighted test above leaves the weighted histogram sums — CL
+    hoisting via the weight histogram, zero-weight padding rows —
+    unexercised; the blocked threshold patch applies to the single-device
+    reference side only). Must still equal the single-device fit on the
+    physical subset."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     from machine_learning_replications_tpu.ops import binning, histogram

@@ -403,3 +403,55 @@ def test_per_fold_binning_matches_subset_fits():
 def test_per_fold_binning_defaults_to_shared_bins():
     """The flag is off by default and the shared-bins path is unchanged."""
     assert GBDTConfig().per_fold_binning is False
+
+
+def test_host_stump_engine_matches_sklearn_and_device():
+    """fit() routes one-shot stumps (n_estimators=1, host arrays, hist
+    splitter, device-binning scale) through the numpy engine
+    (gbdt._fit_stump_host) — no XLA compile. It must pick the same split
+    feature as both sklearn's exact stump and the fused device path, hold
+    AUC parity, and honor the NaN contract. Thresholds may differ inside
+    a bin width (quantile candidates, subsampled above 128k rows — the
+    documented hist-splitter deviation)."""
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.data import make_cohort
+    from machine_learning_replications_tpu.data.schema import selected_indices
+    from machine_learning_replications_tpu.models import tree
+    from machine_learning_replications_tpu.utils import metrics
+
+    X, y, _ = make_cohort(n=150_000, seed=2020)
+    X17 = np.ascontiguousarray(X[:, selected_indices()], dtype=np.float32)
+    yf = np.asarray(y, dtype=np.float32)
+    cfg = GBDTConfig(splitter="hist", n_estimators=1)
+    assert gbdt.uses_fused_hist1(cfg, X17.shape[0])
+    params, aux = gbdt.fit(X17, yf, cfg)
+    # device-array inputs take the fused XLA path; same structure
+    params_dev, _ = gbdt.fit(jnp.asarray(X17), jnp.asarray(yf), cfg)
+    np.testing.assert_array_equal(
+        np.asarray(params.feature), np.asarray(params_dev.feature)
+    )
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    sk = GradientBoostingClassifier(
+        n_estimators=1, max_depth=1, random_state=2020
+    )
+    sk.fit(X17, np.asarray(y))
+    t = sk.estimators_[0, 0].tree_
+    assert int(np.asarray(params.feature)[0, 0]) == int(t.feature[0])
+
+    ours = np.asarray(tree.predict_proba1(params, jnp.asarray(X17)))
+    theirs = sk.predict_proba(X17)[:, 1]
+    auc_ours = float(metrics.roc_auc(jnp.asarray(yf), jnp.asarray(ours)))
+    auc_sk = float(metrics.roc_auc(jnp.asarray(yf), jnp.asarray(theirs)))
+    assert abs(auc_ours - auc_sk) < 5e-3
+    # deviance against sklearn's own binomial deviance after one stage
+    np.testing.assert_allclose(
+        float(aux["train_deviance"][0]), float(sk.train_score_[0]), rtol=1e-3
+    )
+
+    Xn = X17.copy()
+    Xn[0, 0] = np.nan
+    with pytest.raises(ValueError, match="NaN"):
+        gbdt.fit(Xn, yf, cfg)
