@@ -1,0 +1,40 @@
+#!/bin/bash
+# Queued TPU measurements for the next healthy tunnel window (the axon
+# relay wedges for hours at a time — see docs/SCALING.md and the bench
+# probe/queue discipline). Run this THE MOMENT a probe answers; order is
+# by evidence value per minute:
+#   1. full five-config driver-path bench  -> BENCH_manual_r05_tpu.json
+#   2. 4M-row end-to-end pipeline          -> SCALE_r05_4m.json
+#   3. standalone config-4 re-measure (only if 1 lost its c4 leg)
+# Each step tolerates a mid-run wedge: bench.py self-flushes on SIGTERM/
+# SIGALRM, and the pipeline runner takes --checkpoint-dir so a re-entry
+# resumes finished stages.
+set -x
+cd "$(dirname "$0")/.."
+
+timeout 90 python -c "import jax; d=jax.devices()[0]; print('PROBE', d.platform, d.device_kind)" || {
+    echo "tunnel still wedged; aborting queue"; exit 1; }
+
+# 1. the headline: full five-config run through the exact driver path
+timeout 1700 python bench.py --budget 1600 \
+    --detail-out BENCH_manual_r05_tpu.json | tee /tmp/bench_r05_tpu_line.txt
+
+# 2. the scale proof: 4M-row end-to-end fit_pipeline (impute->select->stack)
+timeout 3000 python tools/fit_pipeline_at_scale.py --rows 4000000 \
+    --checkpoint-dir /tmp/scale_r05_ckpt | tee SCALE_r05_4m.json
+
+# 3. config 4 at the post-restructure HEAD (skip if step 1 already has it)
+python - <<'EOF'
+import json, subprocess, sys
+try:
+    d = json.load(open("BENCH_manual_r05_tpu.json"))
+    c4 = (d.get("configs") or {}).get("4", {})
+    if c4.get("vs_baseline") and "tpu" in str(c4.get("device", "")).lower() \
+            or "TPU" in str(c4.get("device", "")):
+        print("c4 already captured on TPU; skipping standalone leg")
+        sys.exit(0)
+except Exception as e:
+    print("no usable r05 artifact c4 cell:", e)
+subprocess.run(["timeout", "900", "python", "bench.py", "--config", "4",
+                "--budget", "800", "--detail-out", "BENCH_manual_r05_c4_tpu.json"])
+EOF
