@@ -117,6 +117,13 @@ def _fit_fingerprint(X64, y, cfg) -> str:
     return h.hexdigest()
 
 
+def _run_array_stage(stages, name: str, compute):
+    """``stages.run`` for a stage whose output is ONE array: wraps it in a
+    one-key dict (orbax's standard handler refuses bare array items) and
+    unwraps on the way out, so call sites can't forget the dance."""
+    return stages.run(name, lambda: {"oof": compute()})["oof"]
+
+
 def _make_stages(checkpoint_dir, _interrupt_after, fingerprint=None):
     if checkpoint_dir is None:
         return _NullStages()
@@ -202,10 +209,10 @@ def fit_stacking(
 
     # --- cross_val_predict meta-features ----------------------------------
     def _fit_meta():
-        # Only the fitted meta-LR is checkpointed — the [n, 3] meta-feature
-        # matrix is an intermediate (checkpointing it would write hundreds
-        # of discarded MB at the 10M-row scale).
-        meta_X = cross_val_member_probas(X, y, cfg, mesh=mesh)
+        # The CV pass checkpoints per-member OOF columns itself (its
+        # docstring has the cost/benefit math); this outer stage holds
+        # only the cheap meta-LR Newton fit.
+        meta_X = cross_val_member_probas(X, y, cfg, mesh=mesh, stages=stages)
         return solvers.logreg_l2_fit(
             jnp.asarray(meta_X), yj, C=cfg.meta.C,
             tol=cfg.meta.tol, max_iter=cfg.meta.max_iter,
@@ -247,7 +254,8 @@ def _svc_fit_rows(
 
 
 def cross_val_member_probas(
-    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig, mesh=None
+    X: np.ndarray, y: np.ndarray, cfg: ExperimentConfig, mesh=None,
+    stages=None,
 ) -> np.ndarray:
     """Out-of-fold P(class 1) per member — the ``[n, 3]`` meta-feature matrix
     (sklearn: ``cross_val_predict(est, X, y, cv=5, method='predict_proba')``
@@ -265,9 +273,19 @@ def cross_val_member_probas(
     reductions"): the SVC fold fit zeroes excluded rows' box constraints
     (``C_i = 0`` ⇒ α_i = 0), the GBDT fold fit parks them at node −1 with
     zero gradient, and the L1-LR fold fit zeroes their loss weight.
+
+    ``stages`` (a ``StageCheckpointer``) makes each member's out-of-fold
+    column its own durable sub-stage: the CV pass is the longest stage of
+    ``fit_pipeline`` at scale (five SVC fold fits dominate), and as one
+    monolithic stage a preemption anywhere inside it lost everything — a
+    measured 1M-row CPU run restored its five earlier stages in under a
+    second and then re-ran the whole 40-minute CV from zero, twice. One
+    ``[n]`` f32 column per member (~40 MB at 10M rows) is the write cost.
     """
     import jax
 
+    if stages is None:
+        stages = _NullStages()
     X = np.asarray(X)
     y = np.asarray(y)
     n = X.shape[0]
@@ -286,92 +304,110 @@ def cross_val_member_probas(
     # --- SVC pipeline: fold scaler refit + masked dual + nested Platt CV ---
     # (sklearn clones the whole Pipeline per fold, so the scaler refits on
     # the fold's train rows; the nested Platt folds stratify *within* them.)
+    # Every sub-stage closure below does ALL its prep inside the closure, so
+    # a restored stage skips the prep too, not just the fits.
     if n > cfg.svc.max_rows:
         # Scaled regime: the masked path still materializes the full [n, n]
         # kernel, so fold fits move to physical stratified subsets of
         # ``max_rows`` rows each (one static shape → still one vmapped
         # program) with chunked out-of-fold prediction.
-        svc_oof = jnp.asarray(
-            _svc_oof_subsampled(X, y, test_masks_np, train_masks_np, cfg),
-            dtype,
-        )
-    else:
-        platt_masks = jnp.asarray(
-            np.stack([
-                stratified_kfold_test_masks_within(y, cfg.svc.platt_cv, tm)
-                for tm in train_masks_np
-            ]),
-            dtype,
-        )  # [k, platt_cv, n]
-
-        def one_fold_svc(tm, pm):
-            sp = scaler.fit(Xj, sample_weight=tm)
-            Xt = scaler.transform(sp, Xj)
-            vp = svm.svc_fit_masked(
-                Xt, yj, tm, pm,
-                C=cfg.svc.C,
-                gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
-                balanced=cfg.svc.class_weight == "balanced",
-                tol=cfg.svc.tol, max_iter=cfg.svc.max_iter,
+        def _svc_oof_fn():
+            return jnp.asarray(
+                _svc_oof_subsampled(X, y, test_masks_np, train_masks_np, cfg),
+                dtype,
             )
-            return svm.predict_proba1(vp, Xt)
+    else:
+        def _svc_oof_fn():
+            platt_masks = jnp.asarray(
+                np.stack([
+                    stratified_kfold_test_masks_within(y, cfg.svc.platt_cv, tm)
+                    for tm in train_masks_np
+                ]),
+                dtype,
+            )  # [k, platt_cv, n]
 
-        p_svc = _svc_fold_map(
-            one_fold_svc, (train_masks, platt_masks),
-            m=n, k=k, itemsize=Xj.dtype.itemsize,
-        )  # [k, n]
-        svc_oof = jnp.sum(p_svc * test_masks, axis=0)
+            def one_fold_svc(tm, pm):
+                sp = scaler.fit(Xj, sample_weight=tm)
+                Xt = scaler.transform(sp, Xj)
+                vp = svm.svc_fit_masked(
+                    Xt, yj, tm, pm,
+                    C=cfg.svc.C,
+                    gamma=None if cfg.svc.gamma == "scale" else cfg.svc.gamma,
+                    balanced=cfg.svc.class_weight == "balanced",
+                    tol=cfg.svc.tol, max_iter=cfg.svc.max_iter,
+                )
+                return svm.predict_proba1(vp, Xt)
+
+            p_svc = _svc_fold_map(
+                one_fold_svc, (train_masks, platt_masks),
+                m=n, k=k, itemsize=Xj.dtype.itemsize,
+            )  # [k, n]
+            return jnp.sum(p_svc * test_masks, axis=0)
+
+    svc_oof = _run_array_stage(stages, "meta_svc_oof", _svc_oof_fn)
 
     # --- GBDT: mask-parked fold fits, one program for all k folds ---------
     if mesh is not None:
-        from machine_learning_replications_tpu.ops import binning
-        from machine_learning_replications_tpu.parallel import fit_gbdt_sharded
-
-        # Same binning gate as gbdt.default_bins: empirical-quantile device
-        # binning only in the scaled 'hist' regime (where host np.unique
-        # would dominate); everywhere else — including every parity-test
-        # size — host unique-value bins keep the mesh path's candidates
-        # identical to fit_folds', so meta-features match bit-for-bit.
-        if cfg.gbdt.per_fold_binning:
-            # Reference-exact protocol under the mesh too: host-bin each
-            # fold's own rows, re-bin all rows against those thresholds
-            # (excluded rows carry weight 0 — parked). Threshold widths
-            # differ per fold, so each fold may compile its own program.
-            budget = gbdt.bin_budget_capped(cfg.gbdt)
-            X_np = np.asarray(X)
-
-            def fold_bins_for(j):
-                bf = binning.bin_features(
-                    X_np[np.asarray(train_masks_np[j]) > 0], budget
-                )
-                return binning.BinnedFeatures(
-                    binned=binning.rebin_with_thresholds(
-                        X_np, bf.thresholds, bf.n_bins
-                    ),
-                    thresholds=bf.thresholds,
-                    n_bins=bf.n_bins,
-                )
-        elif (
-            cfg.gbdt.splitter == "hist"
-            and X.shape[0] >= gbdt.DEVICE_BINNING_MIN_ROWS
-        ):
-            fold_bins = binning.bin_features_device(
-                X, gbdt.bin_budget_capped(cfg.gbdt)
+        def _gbdt_oof():
+            from machine_learning_replications_tpu.ops import binning
+            from machine_learning_replications_tpu.parallel import (
+                fit_gbdt_sharded,
             )
-        else:
-            fold_bins = binning.bin_features(X, gbdt.bin_budget_capped(cfg.gbdt))
-        probas = []
-        for j in range(k):  # one compiled program, k reuses (shared bins)
-            gp_j, _ = fit_gbdt_sharded(
-                mesh, X, y, cfg.gbdt,
-                bins=fold_bins_for(j) if cfg.gbdt.per_fold_binning else fold_bins,
-                sample_weight=train_masks_np[j],
-            )
-            probas.append(tree.predict_proba1(gp_j, Xj))
-        p_gbdt = jnp.stack(probas)  # [k, n]
+
+            # Same binning gate as gbdt.default_bins: empirical-quantile
+            # device binning only in the scaled 'hist' regime (where host
+            # np.unique would dominate); everywhere else — including every
+            # parity-test size — host unique-value bins keep the mesh
+            # path's candidates identical to fit_folds', so meta-features
+            # match bit-for-bit.
+            if cfg.gbdt.per_fold_binning:
+                # Reference-exact protocol under the mesh too: host-bin
+                # each fold's own rows, re-bin all rows against those
+                # thresholds (excluded rows carry weight 0 — parked).
+                # Threshold widths differ per fold, so each fold may
+                # compile its own program.
+                budget = gbdt.bin_budget_capped(cfg.gbdt)
+                X_np = np.asarray(X)
+
+                def fold_bins_for(j):
+                    bf = binning.bin_features(
+                        X_np[np.asarray(train_masks_np[j]) > 0], budget
+                    )
+                    return binning.BinnedFeatures(
+                        binned=binning.rebin_with_thresholds(
+                            X_np, bf.thresholds, bf.n_bins
+                        ),
+                        thresholds=bf.thresholds,
+                        n_bins=bf.n_bins,
+                    )
+            elif (
+                cfg.gbdt.splitter == "hist"
+                and X.shape[0] >= gbdt.DEVICE_BINNING_MIN_ROWS
+            ):
+                fold_bins = binning.bin_features_device(
+                    X, gbdt.bin_budget_capped(cfg.gbdt)
+                )
+            else:
+                fold_bins = binning.bin_features(
+                    X, gbdt.bin_budget_capped(cfg.gbdt)
+                )
+            probas = []
+            for j in range(k):  # one compiled program, k reuses (shared bins)
+                gp_j, _ = fit_gbdt_sharded(
+                    mesh, X, y, cfg.gbdt,
+                    bins=(fold_bins_for(j) if cfg.gbdt.per_fold_binning
+                          else fold_bins),
+                    sample_weight=train_masks_np[j],
+                )
+                probas.append(tree.predict_proba1(gp_j, Xj))
+            return jnp.sum(jnp.stack(probas) * test_masks, axis=0)
     else:
-        gp = gbdt.fit_folds(X, y, train_masks_np, cfg.gbdt)
-        p_gbdt = jax.vmap(lambda p: tree.predict_proba1(p, Xj))(gp)  # [k, n]
+        def _gbdt_oof():
+            gp = gbdt.fit_folds(X, y, train_masks_np, cfg.gbdt)
+            p_gbdt = jax.vmap(lambda p: tree.predict_proba1(p, Xj))(gp)
+            return jnp.sum(p_gbdt * test_masks, axis=0)
+
+    gbdt_oof = _run_array_stage(stages, "meta_gbdt_oof", _gbdt_oof)
 
     # --- L1 logistic regression: masked FISTA --------------------------
     def one_fold_lg(tm):
@@ -382,18 +418,14 @@ def cross_val_member_probas(
         )
         return linear.predict_proba1(lp, Xj)
 
-    p_lg = jax.vmap(one_fold_lg)(train_masks)  # [k, n]
+    lg_oof = _run_array_stage(stages, "meta_lg_oof", lambda: jnp.sum(
+        jax.vmap(one_fold_lg)(train_masks) * test_masks, axis=0
+    ))
 
     # Out-of-fold assembly: each row's meta-feature comes from the one fold
-    # whose test mask contains it.
-    meta = jnp.stack(
-        [
-            svc_oof,
-            jnp.sum(p_gbdt * test_masks, axis=0),
-            jnp.sum(p_lg * test_masks, axis=0),
-        ],
-        axis=1,
-    )
+    # whose test mask contains it (the per-member sums happened inside the
+    # checkpointable sub-stages above).
+    meta = jnp.stack([svc_oof, gbdt_oof, lg_oof], axis=1)
     return np.asarray(meta)
 
 
@@ -546,8 +578,9 @@ def fit_pipeline(
 
     def _select():
         mask, info = feature_selection.fit_select(X_imp, y, cfg.select, mesh=mesh)
-        # Flattened to a sidecar-encodable tuple (dicts aren't pytree
-        # checkpoint nodes); rebuilt below. −1 = no subsampling happened.
+        # Flattened to a sidecar-encodable tuple (predates the sidecar's
+        # 'mapping' dict support; a keyed dict would be the simpler
+        # encoding today); rebuilt below. −1 = no subsampling happened.
         return (
             jnp.asarray(mask), jnp.asarray(info["coef"]), info["intercept"],
             info["alpha_"], jnp.asarray(info["alphas"]),

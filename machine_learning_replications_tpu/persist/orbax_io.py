@@ -118,6 +118,14 @@ def _encode_template(node: Any) -> Any:
     if isinstance(node, (tuple, list)):
         return {"seq": [_encode_template(x) for x in node],
                 "tuple": isinstance(node, tuple)}
+    if isinstance(node, dict):
+        # 'mapping' holds the children. Decode preserves JSON insertion
+        # order; that is immaterial because jax flattens dict pytrees in
+        # sorted-key order regardless (string keys only — a sidecar is
+        # JSON).
+        if not all(isinstance(k, str) for k in node):
+            raise TypeError("cannot sidecar a dict with non-string keys")
+        return {"mapping": {k: _encode_template(v) for k, v in node.items()}}
     if node is None or isinstance(node, (bool, int, float, str)):
         return {"static": node}
     raise TypeError(f"cannot sidecar a {type(node).__name__} leaf")
@@ -137,6 +145,8 @@ def _decode_template(node: Any) -> Any:
     if "seq" in node:
         items = [_decode_template(x) for x in node["seq"]]
         return tuple(items) if node.get("tuple", True) else items
+    if "mapping" in node:
+        return {k: _decode_template(v) for k, v in node["mapping"].items()}
     if "static" in node:
         return node["static"]
     raise ValueError(f"malformed sidecar node: {sorted(node)}")

@@ -243,3 +243,41 @@ def test_resumable_deeper_path(tmp_path, cohort_full):
     np.testing.assert_array_equal(
         np.asarray(resumed.value), np.asarray(direct.value)
     )
+
+
+def test_cv_substage_resume_equals_unbroken(tmp_path, cohort):
+    """The CV meta pass is the longest stage at scale and is now split
+    into per-member OOF sub-stages (meta_svc_oof / meta_gbdt_oof /
+    meta_lg_oof): a preemption right after the GBDT OOF column must
+    restore the SVC and GBDT columns on re-entry — only the LG column and
+    the meta-LR recompute — and still equal an unbroken fit bit for bit."""
+    from machine_learning_replications_tpu.config import (
+        ExperimentConfig, GBDTConfig, LassoSelectConfig, SVCConfig,
+    )
+    from machine_learning_replications_tpu.models import pipeline
+
+    X, y, _ = cohort
+    X = np.asarray(X[:220])
+    y = np.asarray(y[:220])
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=8),
+        svc=SVCConfig(platt_cv=2, max_iter=300),
+        select=LassoSelectConfig(cv_folds=3, n_alphas=20),
+    )
+    unbroken, _ = pipeline.fit_pipeline(X, y, cfg)
+
+    ckdir = str(tmp_path / "cv_stages")
+    with pytest.raises(orbax_io.SimulatedInterrupt):
+        pipeline.fit_pipeline(
+            X, y, cfg, checkpoint_dir=ckdir, _interrupt_after="meta_gbdt_oof"
+        )
+    ck = orbax_io.StageCheckpointer(ckdir)
+    assert ck.completed("meta_svc_oof") and ck.completed("meta_gbdt_oof")
+    assert not ck.completed("meta_lg_oof") and not ck.completed("meta")
+
+    resumed, _ = pipeline.fit_pipeline(X, y, cfg, checkpoint_dir=ckdir)
+    assert ck.completed("meta")
+    for got, want in zip(
+        jax.tree.leaves(resumed), jax.tree.leaves(unbroken)
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
