@@ -560,7 +560,10 @@ def _fit_stump_host(
     h_const = p1 * (1.0 - p1)
     binary_y = bool(histogram.is_binary_labels(np.asarray(y)))
     y_bool = np.asarray(y) > 0.5 if binary_y else None
-    step = max(1, n // _STUMP_CANDIDATE_SAMPLE)
+    # round-based: keeps the sample NEAR the documented 128k target
+    # (floor division left 131k < n < 262k paying a full-cohort partition;
+    # ceil division would halve the sample just past the threshold)
+    step = max(1, round(n / _STUMP_CANDIDATE_SAMPLE))
 
     def col_stats(f):
         col = X[:, f]
