@@ -74,8 +74,13 @@ def fit(
     y: np.ndarray,
     cfg: GBDTConfig = GBDTConfig(),
     bins: binning.BinnedFeatures | None = None,
+    max_layout_bytes: int | None = None,
 ) -> tuple[TreeEnsembleParams, dict[str, Any]]:
     """Fit the boosted ensemble; returns (params, aux) with the deviance path.
+
+    ``max_layout_bytes`` overrides the depth-1 sorted-layout memory guard
+    (``_guard_stump_layout``) for hosts with headroom beyond the default
+    4 GiB budget.
 
     Contract note (ADVICE r3): on the fused hist/depth-1 path (binary
     labels, >= ``DEVICE_BINNING_MIN_ROWS`` rows) ``aux['train_deviance']``
@@ -149,6 +154,9 @@ def fit(
         # Built on device: the host build's argsort + layout loop was the
         # dominant cost of the whole fit at bench scale (same result —
         # stable argsort matches numpy's).
+        _guard_stump_layout(
+            bins, int(bins.binned.shape[0]), budget=max_layout_bytes
+        )
         sd = histogram.build_stump_data_device(bins, y)
         feature, threshold, value, is_split, deviance = _fit_stumps(
             sd,
@@ -899,6 +907,76 @@ def bin_budget_capped(cfg: GBDTConfig) -> int:
     level-wise grower, whose allocation scales with the bin count)."""
     b = bin_budget(cfg)
     return cfg.n_bins if b is None else b
+
+
+# Rough per-fit budget for the depth-1 sorted layout's dominant
+# allocations; the exact splitter's unbounded candidate set can push these
+# to TBs on continuous columns at scale, and an explicit refusal with
+# sizing advice beats an allocator OOM mid-fit. Overridable per fit via
+# ``fit(..., max_layout_bytes=...)`` (mirrors the sharded trainer's guard).
+_STUMP_LAYOUT_BYTES_BUDGET = 4 << 30
+
+
+def _stump_layout_bytes(n: int, F: int, B: int) -> int:
+    """Estimated dominant allocations of the depth-1 sorted layout at
+    ``B`` split candidates: the ``[F, F, n]`` bins_x tensor plus (above
+    the blocked-boundary threshold) the per-stage ``[F, B-1, block]``
+    boundary-partial buffer."""
+    itemsize = 1 if B <= 256 else 2 if B <= 65536 else 4
+    est = F * F * n * itemsize
+    if n >= histogram._BLOCKED_BOUNDARY_MIN_N:
+        est += F * max(B - 1, 1) * histogram._BOUNDARY_BLOCK * 8
+    return est
+
+
+def scaled_member_cfg(
+    cfg: GBDTConfig, n_rows: int, n_features: int = 17
+) -> GBDTConfig:
+    """The pipeline's full-data GBDT member fit at scale: depth-1 exact
+    enumeration's candidate set is the column's unique midpoints — a
+    continuous column contributes ~n candidates, and the sorted layout
+    plus the boundary machinery scale with the candidate count (a 2M-row
+    cohort OOM'd a multi-TB intermediate this way, r5). The member
+    switches to the quantile-binned 'hist' protocol — the same bounded,
+    AUC-parity-budgeted deviation the CV fold fits already document via
+    ``bin_budget_capped`` — when either gate trips: device-binning scale,
+    or a worst-case (B ≈ n) layout estimate past the guard budget (the
+    region below 100k rows where ``fit`` would otherwise refuse).
+    Depth ≥ 2 configs pass through: their exact budget is already
+    quantile-capped (``bin_budget``) and the layout guard never runs."""
+    import dataclasses
+
+    if cfg.splitter != "exact" or cfg.max_depth != 1:
+        return cfg
+    if n_rows >= DEVICE_BINNING_MIN_ROWS or (
+        _stump_layout_bytes(n_rows, n_features, n_rows)
+        > _STUMP_LAYOUT_BYTES_BUDGET
+    ):
+        return dataclasses.replace(cfg, splitter="hist")
+    return cfg
+
+
+def _guard_stump_layout(
+    bins: binning.BinnedFeatures, n: int, budget: int | None = None
+) -> None:
+    F = bins.binned.shape[1]
+    B = int(bins.max_bins)
+    est = _stump_layout_bytes(n, F, B)
+    budget = _STUMP_LAYOUT_BYTES_BUDGET if budget is None else budget
+    if est > budget:
+        hint = (
+            "the 'exact' splitter enumerates every unique midpoint, which "
+            "is unbounded on continuous columns at this row count — use "
+            "splitter='hist' (quantile candidates, AUC-parity-budgeted), "
+            if B > 1024 else
+            "the [F, F, n] sorted layout scales with feature count "
+            "squared — "
+        )
+        raise RuntimeError(
+            f"depth-1 sorted layout would need ~{est:,} bytes "
+            f"(F={F}, n={n}, candidates={B}) > budget {budget:,}: {hint}"
+            "raise max_layout_bytes, or fit fewer rows."
+        )
 
 
 @functools.partial(

@@ -188,13 +188,19 @@ def fit_stacking(
         return scaler_p, svc_p
 
     def _fit_gbdt():
+        # At device-binning scale the exact splitter's candidate set is
+        # unbounded (≈ n candidates per continuous column, r5 OOM):
+        # the member switches to the capped hist protocol there — see
+        # gbdt.scaled_member_cfg.
+        X_np = np.asarray(X)
+        gcfg = gbdt.scaled_member_cfg(cfg.gbdt, X_np.shape[0], X_np.shape[1])
         if mesh is not None:
             from machine_learning_replications_tpu.parallel import (
                 fit_gbdt_sharded,
             )
 
-            return fit_gbdt_sharded(mesh, np.asarray(X), np.asarray(y), cfg.gbdt)[0]
-        return gbdt.fit(np.asarray(X), np.asarray(y), cfg.gbdt)[0]
+            return fit_gbdt_sharded(mesh, np.asarray(X), np.asarray(y), gcfg)[0]
+        return gbdt.fit(np.asarray(X), np.asarray(y), gcfg)[0]
 
     def _fit_lg():
         return solvers.logreg_l1_fit(

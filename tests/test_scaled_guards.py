@@ -107,3 +107,49 @@ def test_scaled_cross_val_meta_features_valid(xy17):
     meta = pipeline.cross_val_member_probas(X, y, cfg)
     assert meta.shape == (X.shape[0], 3)
     assert ((meta > 0) & (meta < 1)).all()
+
+
+def test_exact_stump_layout_guard_and_member_cap(monkeypatch):
+    """The exact splitter's candidate set is unbounded on continuous
+    columns (~n unique midpoints); at 2M rows the depth-1 layout's
+    B-scaled intermediates OOM'd multi-TB allocations (r5). Two defenses:
+    gbdt.fit refuses with sizing advice when the estimated layout exceeds
+    its budget, and the pipeline's full-data member fit switches to the
+    capped hist protocol at device-binning scale."""
+    import numpy as np
+    import pytest
+
+    from machine_learning_replications_tpu.config import GBDTConfig
+    from machine_learning_replications_tpu.models import gbdt
+
+    # policy assertions first — the guard check below shrinks the module
+    # budget that scaled_member_cfg also reads
+    cfg = GBDTConfig(splitter="exact")
+    assert gbdt.scaled_member_cfg(cfg, 20_000, 17).splitter == "exact"
+    scaled = gbdt.scaled_member_cfg(cfg, gbdt.DEVICE_BINNING_MIN_ROWS, 17)
+    assert scaled.splitter == "hist"
+    assert scaled.n_estimators == cfg.n_estimators  # only the splitter moves
+    # below the scale gate, a worst-case layout estimate past the budget
+    # ALSO switches (the region where fit() would otherwise refuse)
+    assert gbdt.scaled_member_cfg(cfg, 60_000, 25).splitter == "hist"
+    # hist configs pass through untouched at any size, and depth>=2 exact
+    # is already quantile-capped so it passes through too
+    hist_cfg = GBDTConfig(splitter="hist")
+    assert gbdt.scaled_member_cfg(hist_cfg, 10**7, 17) is hist_cfg
+    deep = GBDTConfig(splitter="exact", max_depth=2)
+    assert gbdt.scaled_member_cfg(deep, 10**7, 17) is deep
+    # the guard override threads through fit()
+    rng2 = np.random.default_rng(1)
+    X2 = rng2.normal(size=(4000, 3))
+    y2 = (X2[:, 0] > 0).astype(float)
+    params, _ = gbdt.fit(
+        X2, y2, GBDTConfig(n_estimators=2, splitter="exact"),
+        max_layout_bytes=1 << 34,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4000, 3))  # continuous → ~4000 candidates/column
+    y = (X[:, 0] > 0).astype(float)
+    monkeypatch.setattr(gbdt, "_STUMP_LAYOUT_BYTES_BUDGET", 1 << 10)
+    with pytest.raises(RuntimeError, match="splitter='hist'"):
+        gbdt.fit(X, y, GBDTConfig(n_estimators=2, splitter="exact"))
