@@ -72,7 +72,12 @@ def _layout_plan(n: int, F: int, max_bins: int, n_data: int, n_model: int):
     'xla' stump_histograms engine materializes an int32 segment id plus a
     broadcast f64 scatter operand over the [n_local, F_loc] tile per
     stage (~24 B/element of transient), on top of the model-replicated
-    bin matrix and the ~6 per-row f32/f64 vectors each stage touches."""
+    bin matrix and the ~6 per-row f32/f64 vectors each stage touches.
+    The B-scaled replicated arrays (thresholds, per-tile histograms and
+    their cumsums) are counted too: an uncapped 'exact' candidate set at
+    scale puts B ≈ n, and the same unbounded-candidate pathology that
+    OOM'd the single-device member fit (gbdt._guard_stump_layout) must
+    trip this guard rather than the allocator."""
     F_pad = -(-F // n_model) * n_model
     n_local = -(-n // n_data)
     F_loc = F_pad // n_model
@@ -83,7 +88,10 @@ def _layout_plan(n: int, F: int, max_bins: int, n_data: int, n_model: int):
     )
     per_shard = n_local * (
         F_pad * np.dtype(bin_dtype).itemsize + F_loc * 24 + 6 * 8
-    )
+    ) + max_bins * (F_pad + 9 * F_loc) * 8
+    # 9 ≈ the peak count of simultaneous [F_loc, B(-1)] f64 arrays in a
+    # stage: hist + cumsum (2 each), CL, thr slice, and the scoring
+    # temporaries (GR/CR/diff/proxy overlap the first four's lifetimes).
     return F_pad, n_local, bin_dtype, per_shard
 
 
@@ -113,8 +121,11 @@ def _fit_raw(
             f"stump_trainer: per-shard working set needs {per_shard:,} bytes "
             f"(F={F}, n_local={n_local}, max_bins={B}, "
             f"bin dtype {np.dtype(bin_dtype).name}) > budget {budget:,} bytes. "
-            "Add data shards to the mesh, use splitter='hist' (n_bins<=256 "
-            "makes bin ids uint8), or route through parallel.hist_trainer."
+            "Use splitter='hist' (bounds the candidate count; n_bins<=256 "
+            "makes bin ids uint8) or route through parallel.hist_trainer; "
+            "adding data shards helps only the row-scaled portion — the "
+            "candidate-scaled arrays are model-replicated and do not shard "
+            "with 'data'."
         )
 
     import jax.numpy as jnp
