@@ -13,6 +13,9 @@ import tools — are real subcommands of
   predict         load a model (Orbax dir, or the reference pickle) and
                   print the probability for a patient (JSON or the built-in
                   ``predict_hf.py:5-27`` example)
+  serve           micro-batched HTTP inference server over a warm bucketed
+                  compile cache (/predict, /healthz, /metrics —
+                  docs/SERVING.md)
   sweep           5-fold CV over the n_estimators × max_depth grid
                   (BASELINE.json config 4)
   import-sklearn  decode a legacy sklearn pickle → Orbax checkpoint
@@ -138,69 +141,93 @@ def cmd_train(args) -> int:
     return 0
 
 
-def cmd_predict(args) -> int:
+def _load_patient(path: str | None) -> np.ndarray:
+    """Patient JSON path → validated ``(1, 17)`` contract row (the built-in
+    ``predict_hf.py:5-27`` example without a path)."""
     from machine_learning_replications_tpu.data.examples import (
-        EXAMPLE_PATIENT,
         patient_row,
+        validate_patient,
     )
 
-    if args.patient:
-        with open(args.patient) as f:
-            patient = json.load(f)
-        unknown = set(patient) - set(EXAMPLE_PATIENT)
-        if unknown:
-            raise SystemExit(f"unknown patient variables: {sorted(unknown)}")
-        missing = [k for k in EXAMPLE_PATIENT if k not in patient]
-        if missing:
-            # The inference contract takes all 17 variables (predict_hf.py:5-27);
-            # silently defaulting clinical inputs would be unsafe.
-            raise SystemExit(
-                "patient JSON must provide all 17 variables; missing: "
-                + ", ".join(missing)
-            )
+    if not path:
+        return patient_row()
+    with open(path) as f:
+        patient = json.load(f)
+    try:
+        return validate_patient(patient)
+    except ValueError as exc:
+        # The inference contract takes all 17 variables (predict_hf.py:5-27);
+        # silently defaulting clinical inputs would be unsafe.
+        raise SystemExit(str(exc))
+
+
+def cmd_predict(args) -> int:
+    from machine_learning_replications_tpu.models import pipeline, stacking, tree
+    from machine_learning_replications_tpu.persist import load_inference_params
+
+    x = _load_patient(args.patient)
+    params = load_inference_params(model=args.model, pkl=args.pkl)
+    if isinstance(params, pipeline.PipelineParams):
+        # Full-pipeline checkpoints select their own lasso top-k columns —
+        # route the contract row through impute → support mask → ensemble
+        # (pipeline.pipeline_predict_proba1_contract).
+        prob = float(pipeline.pipeline_predict_proba1_contract(params, x)[0])
+    elif isinstance(params, tree.TreeEnsembleParams):
+        # `sweep --save` checkpoints: a bare GBDT fit on the contractual
+        # 17 columns (models.sweep trains on selected_indices() order).
+        prob = float(tree.predict_proba1(params, x)[0])
     else:
-        patient = None
-
-    if args.model:
-        from machine_learning_replications_tpu.data.schema import selected_indices
-        from machine_learning_replications_tpu.models import pipeline, stacking, tree
-        from machine_learning_replications_tpu.persist import orbax_io
-
-        params = orbax_io.load_model(args.model)
-        if isinstance(params, pipeline.PipelineParams):
-            # A full-pipeline checkpoint selects its own lasso top-k columns
-            # (ascending index order, pipeline.py) — NOT the contractual
-            # 17-variable order. Route the patient through the pipeline:
-            # place the 17 known variables at their schema positions in a
-            # 64-wide row, leave the rest NaN for the KNN imputer (exactly
-            # the pipeline's missing-EHR-value story).
-            width = int(params.support_mask.shape[0])
-            x64 = np.full((1, width), np.nan)
-            x64[0, selected_indices()] = patient_row(patient).ravel()
-            prob = float(pipeline.pipeline_predict_proba1(params, x64)[0])
-        elif isinstance(params, tree.TreeEnsembleParams):
-            # `sweep --save` checkpoints: a bare GBDT fit on the contractual
-            # 17 columns (models.sweep trains on selected_indices() order).
-            x = patient_row(patient).reshape(1, -1)
-            prob = float(tree.predict_proba1(params, x)[0])
-        else:
-            x = patient_row(patient).reshape(1, -1)
-            prob = float(stacking.predict_proba1(params, x)[0])
-    else:
-        from machine_learning_replications_tpu.models import stacking
-        from machine_learning_replications_tpu.persist import (
-            REFERENCE_PKL_PATH,
-            decode_pickle,
-            import_stacking,
-        )
-
-        pkl = args.pkl or REFERENCE_PKL_PATH
-        params = import_stacking(decode_pickle(pkl))
-        x = patient_row(patient).reshape(1, -1)
         prob = float(stacking.predict_proba1(params, x)[0])
 
     # Output contract: predict_hf.py:38-40
     print(f"Probability of progressive HF is: {100.0 * prob:.2f} %")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Micro-batched HTTP inference serving (docs/SERVING.md)."""
+    import signal
+
+    from machine_learning_replications_tpu.persist import load_inference_params
+    from machine_learning_replications_tpu.serve import make_server
+
+    params = load_inference_params(model=args.model, pkl=args.pkl)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    handle = make_server(
+        params,
+        host=args.host,
+        port=args.port,
+        buckets=buckets,
+        max_batch_size=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        warmup=not args.no_warmup,
+        request_timeout_s=args.request_timeout,
+        quiet=not args.verbose,
+        say=lambda m: print(m, file=sys.stderr),
+    )
+    host, port = handle.address
+    print(
+        f"serving {type(params).__name__} on http://{host}:{port} "
+        f"(buckets {buckets}, max_wait {args.max_wait_ms}ms, "
+        f"queue bound {args.max_queue})",
+        file=sys.stderr,
+    )
+
+    def _graceful(signum, frame):
+        print("draining and shutting down ...", file=sys.stderr)
+        # shutdown() must not run on the signal-handling main thread while
+        # serve_forever is blocked in it — hand it to a helper thread.
+        import threading
+
+        threading.Thread(target=handle.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        handle.serve_forever()
+    finally:
+        handle.shutdown()
     return 0
 
 
@@ -319,6 +346,46 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pkl", help="legacy sklearn pickle (default: the reference artifact)")
     p.add_argument("--patient", help="patient JSON file (default: predict_hf.py example)")
     p.set_defaults(fn=cmd_predict)
+
+    v = sub.add_parser(
+        "serve",
+        help="micro-batched HTTP inference server (/predict, /healthz, /metrics)",
+    )
+    v.add_argument("--model", help="Orbax checkpoint dir from `train --save`")
+    v.add_argument("--pkl", help="legacy sklearn pickle (default: the reference artifact)")
+    v.add_argument("--host", default="127.0.0.1")
+    v.add_argument("--port", type=int, default=8000)
+    v.add_argument(
+        "--buckets", default="1,8,64,512",
+        help="compiled batch-size ladder (comma-separated, ascending); "
+        "every request batch pads up to the next bucket so the jit cache "
+        "stays bounded at one executable per bucket",
+    )
+    v.add_argument(
+        "--max-batch", type=int, default=None,
+        help="micro-batch flush size (default: the largest bucket)",
+    )
+    v.add_argument(
+        "--max-wait-ms", type=float, default=5.0,
+        help="max time the oldest queued request waits for batch-mates",
+    )
+    v.add_argument(
+        "--max-queue", type=int, default=1024,
+        help="admission-queue bound; requests beyond it are shed with an "
+        "explicit 503 'overloaded' reply instead of queueing unboundedly "
+        "(keep above the largest bucket or full batches can never form)",
+    )
+    v.add_argument(
+        "--request-timeout", type=float, default=30.0,
+        help="per-request reply deadline (seconds)",
+    )
+    v.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the startup compile of every bucket (first requests "
+        "then pay the XLA compiles)",
+    )
+    v.add_argument("--verbose", action="store_true", help="log each request")
+    v.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("sweep", help="5-fold CV grid sweep (config 4)")
     add_cohort_flags(s)

@@ -6,6 +6,8 @@ Lasso-selected features in training order (SURVEY.md §2.2).
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 EXAMPLE_PATIENT: dict[str, float] = {
@@ -41,3 +43,39 @@ def patient_row(params: dict[str, float] | None = None) -> np.ndarray:
     ``predict_hf.py:29-31`` does."""
     d = EXAMPLE_PATIENT if params is None else params
     return np.reshape([d[k] for k in EXAMPLE_PATIENT], (1, -1)).astype(np.float64)
+
+
+def validate_patient(patient: dict) -> np.ndarray:
+    """Validate a patient dict against the 17-variable inference contract
+    and return its ``(1, 17)`` row. One gate shared by every inference
+    front end (``cli.py predict``, ``serve``'s ``/predict``): all 17
+    variables present, no unknown keys, numeric values — silently
+    defaulting clinical inputs would be unsafe (``predict_hf.py:5-27``)."""
+    if not isinstance(patient, dict):
+        raise ValueError(
+            f"patient must be a JSON object of the 17 variables, got "
+            f"{type(patient).__name__}"
+        )
+    unknown = set(patient) - set(EXAMPLE_PATIENT)
+    if unknown:
+        raise ValueError(f"unknown patient variables: {sorted(unknown)}")
+    missing = [k for k in EXAMPLE_PATIENT if k not in patient]
+    if missing:
+        raise ValueError(
+            "patient JSON must provide all 17 variables; missing: "
+            + ", ".join(missing)
+        )
+    bad = [
+        k for k, v in patient.items()
+        if isinstance(v, bool)
+        or not isinstance(v, (int, float))
+        or not math.isfinite(v)
+    ]
+    if bad:
+        # NaN/Infinity included: json.loads admits those tokens, a NaN
+        # clinical input would be silently imputed by the pipeline route,
+        # and a NaN probability is not representable in strict JSON.
+        raise ValueError(
+            f"non-numeric or non-finite patient variables: {sorted(bad)}"
+        )
+    return patient_row(patient)

@@ -58,10 +58,11 @@ def default_bins(X, cfg: GBDTConfig) -> binning.BinnedFeatures:
 
 
 def uses_fused_hist1(cfg: GBDTConfig, n_rows: int) -> bool:
-    """Config/shape half of ``fit``'s fused-path gate (the label-binarity
-    half is data-dependent and checked in-flight via the status flag).
-    Shared with ``bench._utilization`` so the reported stage model can
-    never drift from the path the fit actually takes."""
+    """``fit``'s fused-path gate — config/shape only, labels play no part
+    (the r5 unsorted formulation histograms ``g = y − p`` directly, so
+    soft labels ride the fused path too). Shared with
+    ``bench._utilization`` so the reported stage model can never drift
+    from the path the fit actually takes."""
     return (
         cfg.splitter == "hist"
         and cfg.max_depth == 1
@@ -82,8 +83,9 @@ def fit(
     (``_guard_stump_layout``) for hosts with headroom beyond the default
     4 GiB budget.
 
-    Contract note (ADVICE r3): on the fused hist/depth-1 path (binary
-    labels, >= ``DEVICE_BINNING_MIN_ROWS`` rows) ``aux['train_deviance']``
+    Contract note (ADVICE r3): on the fused hist/depth-1 path (>=
+    ``DEVICE_BINNING_MIN_ROWS`` rows; labels may be soft)
+    ``aux['train_deviance']``
     is a DEVICE array — fetching [n_estimators] floats costs a full host
     round trip (~70 ms tunneled), which would be pure overhead inside the
     timed fit. Every other path returns host ``np.ndarray``. Callers that
@@ -95,7 +97,11 @@ def fit(
             cfg.n_estimators == 1
             and uses_fused_hist1(cfg, X.shape[0])
             and isinstance(X, np.ndarray)
+            and isinstance(y, np.ndarray)
         ):
+            # y must be host-resident too: a device y would be pulled
+            # through the tunnel by the np.asarray below, contradicting
+            # the 'device-resident inputs skip this' rationale (ADVICE r5).
             # One-shot single-stump fits never earn their XLA compile: a
             # fresh process pays a ~20 s trace+compile for ~0.4 s of
             # device work (BENCH.md config-2 cold row, VERDICT r4 weak
@@ -106,22 +112,18 @@ def fit(
             # skip this (pulling X back through a ~18 MB/s tunnel would
             # cost more than the compile).
             return _fit_stump_host(X, np.asarray(y), cfg)
-        if uses_fused_hist1(cfg, X.shape[0]) \
-                and not (
-                    isinstance(y, np.ndarray)
-                    and not histogram.is_binary_labels(y)
-                ):
-            # (host-side soft labels skip the fused path up front so the
-            # post-dispatch status fallback doesn't waste a full fit;
-            # device-resident labels keep the zero-pre-sync flag protocol
-            # below)
-            # Fused regime: binning + sorted layout + all boosting stages in
-            # ONE jitted program. The pieces are individually cheap at this
-            # scale but each separate blocking dispatch pays a full host
-            # round trip (~70 ms on the tunneled backend — measured r3);
-            # unfused, dispatch overhead exceeded the actual device work
+        if uses_fused_hist1(cfg, X.shape[0]):
+            # Fused regime: binning + all boosting stages in ONE jitted
+            # program. The pieces are individually cheap at this scale but
+            # each separate blocking dispatch pays a full host round trip
+            # (~70 ms on the tunneled backend — measured r3); unfused,
+            # dispatch overhead exceeded the actual device work
             # severalfold. aux carries the deviance as a device array for
             # the same reason (callers np.asarray it if they want it).
+            # Soft (non-binary) labels take this path too since the r5
+            # unsorted formulation: no label packing remains — each stage
+            # histograms g = y − p directly (ADVICE r5 dropped the gate
+            # and the status bit that used to route them off it).
             fused = _fit_hist1_fused(
                 jnp.asarray(X), jnp.asarray(y),
                 n_bins=cfg.n_bins,
@@ -132,21 +134,15 @@ def fit(
                 backend=resolve_backend(cfg),
             )
             feature, threshold, value, is_split, deviance, f0, status = fused
-            # One sync for the whole fit. NaN is a contract violation
-            # everywhere; non-binary labels only invalidate the packed
-            # label column, so that case falls through to the gather-based
-            # path below — the common binary case pays no pre-dispatch
-            # label check, and soft-label fits keep working (they did
-            # before label packing existed).
-            code = int(status)
-            if code & 2:
+            # One sync for the whole fit: a traced program cannot raise,
+            # so the binning core's NaN flag rides along as an output.
+            if int(status):
                 raise ValueError("input contains NaN; impute before binning")
-            if not code & 1:
-                params = forest_to_params(
-                    feature, threshold, value, is_split,
-                    init_raw=f0, learning_rate=cfg.learning_rate, max_depth=1,
-                )
-                return params, {"train_deviance": deviance}
+            params = forest_to_params(
+                feature, threshold, value, is_split,
+                init_raw=f0, learning_rate=cfg.learning_rate, max_depth=1,
+            )
+            return params, {"train_deviance": deviance}
         bins = default_bins(X, cfg)
     if cfg.max_depth == 1:
         # Gather/scatter-free fast path: replicated sorted layout
@@ -518,11 +514,11 @@ def _fit_hist1_fused(
 
     carry = jax.lax.fori_loop(0, n_stages, stage, carry)
     _, feature, threshold, value, is_split, deviance = carry
-    nonbin_flag = ~histogram.is_binary_labels(yj)
-    # One scalar status ships both conditions (each bool() fetch is a full
-    # host round trip on a tunneled backend): bit 1 = NaN input, bit 0 =
-    # non-binary labels.
-    status = nan_flag.astype(jnp.int32) * 2 + nonbin_flag.astype(jnp.int32)
+    # One scalar status (each bool() fetch is a full host round trip on a
+    # tunneled backend): nonzero = NaN input. The non-binary-label bit is
+    # gone — the unsorted formulation histograms g = y − p directly, so
+    # soft labels are first-class here (ADVICE r5).
+    status = nan_flag.astype(jnp.int32)
     return feature, threshold, value, is_split, deviance, f0, status
 
 
@@ -930,7 +926,7 @@ def _stump_layout_bytes(n: int, F: int, B: int) -> int:
 
 
 def scaled_member_cfg(
-    cfg: GBDTConfig, n_rows: int, n_features: int = 17
+    cfg: GBDTConfig, n_rows: int, n_features: int
 ) -> GBDTConfig:
     """The pipeline's full-data GBDT member fit at scale: depth-1 exact
     enumeration's candidate set is the column's unique midpoints — a
@@ -943,7 +939,11 @@ def scaled_member_cfg(
     or a worst-case (B ≈ n) layout estimate past the guard budget (the
     region below 100k rows where ``fit`` would otherwise refuse).
     Depth ≥ 2 configs pass through: their exact budget is already
-    quantile-capped (``bin_budget``) and the layout guard never runs."""
+    quantile-capped (``bin_budget``) and the layout guard never runs.
+
+    ``n_features`` is required (ADVICE r5): the worst-case layout estimate
+    scales with the column count, and a silent 17-column default would let
+    a wider cohort under-estimate it and skip the hist switch."""
     import dataclasses
 
     if cfg.splitter != "exact" or cfg.max_depth != 1:

@@ -175,15 +175,27 @@ def _block_fn_for(params: KNNImputerParams, X_np: np.ndarray):
     return _block_fn(nan_cols, masked)
 
 
+def resolve_block_fn(params: KNNImputerParams, X_np: np.ndarray):
+    """Resolve the block fn for ``X_np``'s NaN-column pattern ONCE, for
+    callers whose pattern is fixed across many ``transform`` calls (the
+    serving engine: contract rows always miss the same columns). The
+    resolution pays a device reduction plus a blocking device→host fetch
+    of the donor NaN mask — per *pattern* cost, not per-batch cost; pass
+    the result back via ``transform(..., block_fn=...)``."""
+    return _block_fn_for(params, np.asarray(X_np))
+
+
 def transform(
     params: KNNImputerParams,
     X: jnp.ndarray,
     chunk_rows: int | None = None,
     mesh=None,
+    block_fn=None,
 ) -> jnp.ndarray:
-    """The specialised block fn (``_block_fn_for``) over query chunks;
-    single block when the query fits (``chunk_rows=None`` →
-    ``ImputerConfig().chunk_rows``).
+    """The specialised block fn (``_block_fn_for``; or a pre-resolved
+    ``block_fn`` from ``resolve_block_fn`` — correct whenever its query
+    pattern column-matches ``X``'s) over query chunks; single block when
+    the query fits (``chunk_rows=None`` → ``ImputerConfig().chunk_rows``).
 
     With ``mesh``, query rows are sharded over the 'data' axis — the
     imputation of a row depends only on the (replicated) donor matrix, so
@@ -205,11 +217,17 @@ def transform(
         return jnp.asarray(X_np)
     if n_inc < X_np.shape[0]:
         out = np.array(X_np, dtype=X_np.dtype)
+        # Dropping complete rows cannot change which COLUMNS hold NaN, so
+        # a caller-supplied block_fn stays valid for the subset.
         out[incomplete] = np.asarray(
-            transform(params, X_np[incomplete], chunk_rows, mesh=mesh)
+            transform(
+                params, X_np[incomplete], chunk_rows, mesh=mesh,
+                block_fn=block_fn,
+            )
         )
         return jnp.asarray(out)
-    block_fn = _block_fn_for(params, X_np)
+    if block_fn is None:
+        block_fn = _block_fn_for(params, X_np)
     if mesh is not None:
         from machine_learning_replications_tpu.parallel.rowwise import (
             apply_rows_sharded,

@@ -612,6 +612,67 @@ def fit_pipeline(
     )
 
 
+def contract_rows_to_x64(
+    params: PipelineParams, X17: np.ndarray
+) -> np.ndarray:
+    """Embed contract-order 17-variable rows (``predict_hf.py:5-27``) at
+    their schema positions in full-width NaN rows, ready for
+    ``pipeline_predict_proba1``.
+
+    A full-pipeline checkpoint selects its own lasso top-k columns
+    (ascending index order) — NOT the contractual 17-variable order — so
+    inference front ends route contract rows through the pipeline: the 17
+    known variables land at their schema positions, the rest stay NaN for
+    the KNN imputer (exactly the pipeline's missing-EHR-value story).
+    """
+    from machine_learning_replications_tpu.data.schema import selected_indices
+
+    X17 = np.asarray(X17, np.float64)
+    if X17.ndim == 1:
+        X17 = X17[None, :]
+    width = int(params.support_mask.shape[0])
+    x64 = np.full((X17.shape[0], width), np.nan)
+    x64[:, selected_indices()] = X17
+    return x64
+
+
+def impute_select(
+    params: PipelineParams, X64: np.ndarray, mesh=None, block_fn=None
+) -> jnp.ndarray:
+    """KNN-impute raw 64-wide rows and gather the lasso support columns —
+    the front half of full-pipeline inference, ending at the member
+    ensemble's 17-column input. ``pipeline_predict_proba1`` and the
+    serving engine (``serve/engine.py``, which jits its own
+    ``stacking.predict_proba1`` call for the per-bucket compile bound)
+    both run THIS composition, so the two routes cannot drift.
+    ``block_fn`` is ``knn_impute.resolve_block_fn``'s output for callers
+    with a fixed query NaN pattern (the serving hot path resolves it once
+    at engine init instead of paying a device→host sync per batch)."""
+    # X64 passes through host-side: transform normalizes with np.asarray
+    # anyway, and a jnp.asarray here would upload the batch only for
+    # transform to immediately fetch it back — a per-batch device→host
+    # sync on the serving hot path.
+    X_imp = knn_impute.transform(
+        params.imputer, X64, mesh=mesh, block_fn=block_fn
+    )
+    return X_imp[:, np.where(np.asarray(params.support_mask))[0]]
+
+
+def pipeline_predict_proba1_contract(
+    params: PipelineParams, X17: np.ndarray, mesh=None,
+    chunk_rows: int | None = None,
+) -> jnp.ndarray:
+    """Contract-order 17-variable rows → stacked P(class 1) through the
+    full pipeline — the ``cli.py predict --model`` route. The serving
+    engine runs the same ``contract_rows_to_x64`` → ``impute_select`` →
+    ``stacking.predict_proba1`` composition (parity pinned bit-for-bit by
+    ``tests/test_serve.py``)."""
+    return pipeline_predict_proba1(
+        params, contract_rows_to_x64(params, X17),
+        mesh=mesh, chunk_rows=chunk_rows,
+    )
+
+
 def pipeline_predict_proba1(
     params: PipelineParams, X64: np.ndarray, mesh=None,
     chunk_rows: int | None = None,
@@ -630,9 +691,7 @@ def pipeline_predict_proba1(
 
     if chunk_rows is None:
         chunk_rows = SVCConfig().predict_chunk_rows
-    X_imp = knn_impute.transform(params.imputer, jnp.asarray(X64), mesh=mesh)
-    mask = np.asarray(params.support_mask)
-    X17 = X_imp[:, np.where(mask)[0]]
+    X17 = impute_select(params, X64, mesh=mesh)
     if mesh is not None:
         from machine_learning_replications_tpu.parallel.rowwise import (
             apply_rows_sharded,

@@ -19,6 +19,20 @@ from machine_learning_replications_tpu.persist.sklearn_import import (
     import_svc,
 )
 
+def load_inference_params(model: str | None = None, pkl: str | None = None):
+    """Resolve the inference param source every front end shares
+    (``cli.py predict``, ``serve``): an Orbax checkpoint dir when ``model``
+    is given (``PipelineParams`` / ``TreeEnsembleParams`` /
+    ``StackingParams``, per the sidecar), else a legacy sklearn pickle
+    (``pkl``, defaulting to the shipped reference artifact) decoded without
+    executing pickled code."""
+    if model:
+        from machine_learning_replications_tpu.persist import orbax_io
+
+        return orbax_io.load_model(model)
+    return import_stacking(decode_pickle(pkl or REFERENCE_PKL_PATH))
+
+
 # Orbax names resolve lazily (PEP 562) so the pickle-import path stays usable
 # in environments without orbax-checkpoint installed.
 _ORBAX_NAMES = ("abstract_like", "restore_params", "save_params")
@@ -34,6 +48,7 @@ def __getattr__(name):
 
 __all__ = [
     "REFERENCE_PKL_PATH",
+    "load_inference_params",
     "decode_pickle",
     "import_stacking",
     "import_gbdt",

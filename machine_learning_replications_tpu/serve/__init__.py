@@ -1,0 +1,43 @@
+"""L7 — inference serving.
+
+The reference's only inference surface is a synchronous single-patient
+script (``predict_hf.py``); the ROADMAP's "serving heavy traffic" half had
+no subsystem behind it. This package is that subsystem, stdlib-only:
+
+  ``engine``   warm compiled batched predict over a fixed bucket ladder
+               (bounded jit cache, startup warmup, Orbax + pickle params)
+  ``batcher``  thread-safe micro-batching (max-batch / max-wait flush),
+               bounded admission with explicit load shedding, graceful
+               drain
+  ``server``   HTTP front end: ``/predict`` (17-variable patient JSON),
+               ``/healthz``, ``/metrics``
+  ``metrics``  latency quantiles, queue depth, batch-size and
+               padding-waste histograms
+
+Entry point: ``python -m machine_learning_replications_tpu serve``; load
+generator: ``tools/loadgen.py``. Architecture notes: ``docs/SERVING.md``.
+"""
+
+from machine_learning_replications_tpu.serve.batcher import (
+    MicroBatcher,
+    Overloaded,
+)
+from machine_learning_replications_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    BucketedPredictEngine,
+)
+from machine_learning_replications_tpu.serve.metrics import ServingMetrics
+from machine_learning_replications_tpu.serve.server import (
+    ServerHandle,
+    make_server,
+)
+
+__all__ = [
+    "BucketedPredictEngine",
+    "DEFAULT_BUCKETS",
+    "MicroBatcher",
+    "Overloaded",
+    "ServingMetrics",
+    "ServerHandle",
+    "make_server",
+]

@@ -1,0 +1,195 @@
+"""Thread-safe micro-batcher with bounded admission and graceful drain.
+
+The throughput story of the serving layer (Clipper, NSDI '17): individual
+requests arriving within a small window are coalesced into one batched
+device call, because ``pipeline_predict_proba1`` scales with batch size
+while per-call dispatch overhead does not. Flush policy is the standard
+two-knob one — a batch goes to the engine when it reaches
+``max_batch_size`` rows OR the oldest queued request has waited
+``max_wait_ms`` — so light traffic pays at most the wait bound and heavy
+traffic gets full buckets.
+
+Admission is BOUNDED: at most ``max_queue`` requests may be waiting. Past
+that, ``submit`` raises ``Overloaded`` immediately — the server turns that
+into an explicit 503 — instead of queueing unboundedly and converting
+overload into unbounded latency for every client (the load-shedding
+contract; the shed rate is a first-class metric).
+
+``close(drain=True)`` stops admission, flushes everything already
+admitted, and joins the flush thread: an admitted request is never dropped
+by shutdown.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+
+class Overloaded(RuntimeError):
+    """Admission queue full — the request was shed, not queued."""
+
+
+class _Pending:
+    __slots__ = ("row", "future", "t_enqueue")
+
+    def __init__(self, row: np.ndarray) -> None:
+        self.row = row
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesce single-row predict requests into engine-sized batches.
+
+    ``engine`` needs ``predict(X[n, F]) -> p[n]``; when it also exposes
+    ``bucket_for`` (the bucketed engine does), each flush records its
+    padding waste. ``metrics`` is a ``serve.metrics.ServingMetrics`` (or
+    None to run unobserved, e.g. in unit tests).
+    """
+
+    def __init__(
+        self,
+        engine,
+        max_batch_size: int = 64,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 256,
+        metrics=None,
+    ) -> None:
+        if max_batch_size < 1 or max_queue < 1:
+            raise ValueError("max_batch_size and max_queue must be >= 1")
+        self._engine = engine
+        self._max_batch = int(max_batch_size)
+        self._max_wait_s = float(max_wait_ms) / 1000.0
+        self._max_queue = int(max_queue)
+        self._metrics = metrics
+        self._cv = threading.Condition()
+        self._q: deque[_Pending] = deque()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="micro-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, row: np.ndarray) -> Future:
+        """Enqueue one contract-order feature row; resolves to its
+        probability (float). Raises ``Overloaded`` when the admission
+        queue is full and ``RuntimeError`` after ``close``."""
+        row = np.asarray(row, np.float64).ravel()
+        want = getattr(self._engine, "n_features", None)
+        if want is not None and row.shape[0] != want:
+            # Reject at the door: a mis-shaped row admitted here would
+            # only fail later inside a coalesced batch, taking its
+            # batchmates down with it.
+            raise ValueError(
+                f"expected a {want}-feature row, got {row.shape[0]}"
+            )
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._q) >= self._max_queue:
+                if self._metrics is not None:
+                    self._metrics.shed_total.inc()
+                raise Overloaded(
+                    f"admission queue full ({self._max_queue} waiting)"
+                )
+            p = _Pending(row)
+            self._q.append(p)
+            if self._metrics is not None:
+                self._metrics.requests_total.inc()
+                self._metrics.queue_depth.set(len(self._q))
+            self._cv.notify()
+        return p.future
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # -- consumer side -----------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if not self._q and self._closed:
+                    return
+                # Wait out the coalescing window (unless the batch is
+                # already full, or we are draining a closed batcher —
+                # drain flushes at full speed).
+                deadline = self._q[0].t_enqueue + self._max_wait_s
+                while (
+                    len(self._q) < self._max_batch
+                    and not self._closed
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                batch = [
+                    self._q.popleft()
+                    for _ in range(min(len(self._q), self._max_batch))
+                ]
+                if self._metrics is not None:
+                    self._metrics.queue_depth.set(len(self._q))
+            self._flush(batch)
+
+    def _flush(self, batch: list[_Pending]) -> None:
+        # Claim each entry (queued → running). A False return means the
+        # server cancelled it on client-deadline expiry — drop it here so
+        # the engine never computes answers nobody will read. A claimed
+        # future can no longer be cancelled, so set_result below is safe.
+        batch = [p for p in batch if p.future.set_running_or_notify_cancel()]
+        if not batch:
+            return
+        try:
+            # np.stack inside the try: a mis-shaped row slipping past
+            # submit must fail its batch's futures, not kill the flush
+            # thread (which would wedge the batcher permanently).
+            X = np.stack([p.row for p in batch])
+            probs = np.asarray(self._engine.predict(X), np.float64)
+        except Exception as exc:
+            if self._metrics is not None:
+                self._metrics.errors_total.inc(len(batch))
+            for p in batch:
+                p.future.set_exception(exc)
+            return
+        now = time.monotonic()
+        if self._metrics is not None:
+            self._metrics.batches_total.inc()
+            self._metrics.batch_size.observe(len(batch))
+            bucket_for = getattr(self._engine, "bucket_for", None)
+            if bucket_for is not None:
+                self._metrics.padding_waste.observe(
+                    max(bucket_for(len(batch)) - len(batch), 0)
+                )
+            for p in batch:
+                self._metrics.latency.observe(now - p.t_enqueue)
+        for p, prob in zip(batch, probs):
+            p.future.set_result(float(prob))
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: float | None = 30.0) -> None:
+        """Stop admission; with ``drain`` (default) flush every admitted
+        request before returning, otherwise fail them fast."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                while self._q:
+                    p = self._q.popleft()
+                    if p.future.set_running_or_notify_cancel():
+                        p.future.set_exception(
+                            RuntimeError("server shutting down")
+                        )
+                if self._metrics is not None:
+                    self._metrics.queue_depth.set(0)
+            self._cv.notify_all()
+        self._thread.join(timeout)

@@ -1,0 +1,211 @@
+"""Warm bucketed-compile predict engine — the device half of the serving layer.
+
+``jax.jit`` specializes on shape, so a naive server compiles a fresh XLA
+program for every distinct batch size its batcher happens to flush — an
+unbounded compile cache and multi-second tail latencies whenever traffic
+finds a new size. The engine instead pads every batch up to a fixed ladder
+of bucket sizes (Clipper/TF-Serving practice; default ``1/8/64/512``): the
+jit cache is bounded at one executable per bucket, and ``warmup()`` pays
+every compile at startup so the first real request never does.
+
+Padding is row-replication (``np.pad`` edge mode). Every predict path the
+engine serves — stacking members, bare GBDT, the full pipeline — is a pure
+per-row map, so pad rows cannot perturb real rows; they cost device FLOPs,
+which ``serve.metrics`` accounts as ``padding_waste``.
+
+The engine accepts the same three param families as ``cli.py predict``
+(SURVEY.md §2.3 parity oracle):
+
+  * ``stacking.StackingParams`` — the imported-pickle / bare-ensemble case;
+    rows are the contractual 17-variable patient vector.
+  * ``tree.TreeEnsembleParams`` — ``sweep --save`` checkpoints.
+  * ``pipeline.PipelineParams`` — full-pipeline checkpoints; 17-variable
+    rows are embedded at their schema positions in a NaN-padded 64-wide
+    row and routed through ``pipeline.contract_rows_to_x64`` →
+    ``pipeline.impute_select`` → ``stacking.predict_proba1`` — the same
+    composition ``pipeline_predict_proba1_contract`` (the CLI path) runs,
+    with the ensemble pass jitted here for the per-bucket compile bound —
+    so served probabilities match ``predict`` bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Sequence
+
+import numpy as np
+
+DEFAULT_BUCKETS = (1, 8, 64, 512)
+
+
+class BucketedPredictEngine:
+    """Compiled batched predict with a bounded, warm bucket ladder.
+
+    ``trace_counts`` maps bucket size → number of times the engine's jitted
+    core was *traced* at that size (tracing happens exactly once per XLA
+    compile), so tests can assert the compile-cache bound directly instead
+    of inferring it from timing.
+    """
+
+    def __init__(
+        self,
+        params,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+    ) -> None:
+        import jax
+
+        from machine_learning_replications_tpu.models import (
+            pipeline, stacking, tree,
+        )
+
+        buckets = sorted({int(b) for b in buckets})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"bucket ladder must be positive ints, got {buckets!r}")
+        self.buckets = tuple(buckets)
+        self.params = params
+        self.trace_counts: dict[int, int] = {}
+        self.warm = False
+        self.n_features = 17  # the predict_hf.py:5-27 contract width
+
+        if not isinstance(
+            params,
+            (
+                pipeline.PipelineParams,
+                tree.TreeEnsembleParams,
+                stacking.StackingParams,
+            ),
+        ):
+            raise TypeError(
+                f"cannot serve params of type {type(params).__name__}; "
+                "expected PipelineParams, TreeEnsembleParams, or "
+                "StackingParams"
+            )
+        # Params ride as jit ARGUMENTS (not closure constants — numpy
+        # constants cannot be fancy-indexed by tracers inside the staged
+        # program), device_put ONCE here so the ensemble is not re-uploaded
+        # host-to-device on every flushed batch. Same shapes and dtypes
+        # every call, so the executable cache still keys only on the batch
+        # shape — one compile per bucket.
+        dparams = jax.device_put(params)
+        if isinstance(params, pipeline.PipelineParams):
+            from machine_learning_replications_tpu.models import knn_impute
+
+            # ... except the support mask, which stays host-resident:
+            # impute_select np.where's it per call, and a device mask
+            # would cost a blocking device-to-host sync per flushed batch.
+            dparams = dparams.replace(
+                support_mask=np.asarray(params.support_mask)
+            )
+            # Contract rows are all-finite (validate_patient), so every
+            # served x64 batch misses exactly the non-schema columns:
+            # resolve the imputer's pattern-specialised block fn ONCE —
+            # resolution reduces the donor NaN mask on device and blocks
+            # on its fetch, a cost that must not recur per flushed batch
+            # (it would dominate the max_wait_ms budget on remote
+            # backends).
+            contract_block_fn = knn_impute.resolve_block_fn(
+                params.imputer,
+                pipeline.contract_rows_to_x64(
+                    params, np.zeros((1, self.n_features))
+                ),
+            )
+            # Full-pipeline route: host-orchestrated imputation feeding
+            # the jitted stacked-probability core. One imputer compile +
+            # one core compile per bucket.
+            def core(ens, X17sel):
+                self._note_trace(int(X17sel.shape[0]))
+                return stacking.predict_proba1(ens, X17sel)
+
+            jit_core = jax.jit(core)
+
+            def impl(X17: np.ndarray):
+                x64 = pipeline.contract_rows_to_x64(params, X17)
+                # NaN in a 17-var position (possible only for direct
+                # predict() callers — the HTTP path rejects it) widens
+                # the pattern past the pre-resolved fn: fall back to
+                # per-call resolution rather than serve an unimputed NaN.
+                fn = None if np.isnan(X17).any() else contract_block_fn
+                return jit_core(
+                    dparams.ensemble,
+                    pipeline.impute_select(dparams, x64, block_fn=fn),
+                )
+
+        else:
+            # tree.TreeEnsembleParams / stacking.StackingParams: rows are
+            # already the member ensemble's 17-column input — one jitted
+            # call, differing only in the predict function.
+            fn = (
+                tree.predict_proba1
+                if isinstance(params, tree.TreeEnsembleParams)
+                else stacking.predict_proba1
+            )
+
+            def core(p, X):
+                self._note_trace(int(X.shape[0]))
+                return fn(p, X)
+
+            jit_core = jax.jit(core)
+
+            def impl(X):
+                return jit_core(dparams, X)
+
+        self._impl = impl
+
+    def _note_trace(self, rows: int) -> None:
+        # Executes at trace time only (the body is staged out afterwards),
+        # so each increment corresponds to exactly one XLA compile.
+        self.trace_counts[rows] = self.trace_counts.get(rows, 0) + 1
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket holding ``n`` rows (the largest bucket
+        for anything bigger — ``predict`` chunks such batches)."""
+        i = bisect.bisect_left(self.buckets, n)
+        return self.buckets[min(i, len(self.buckets) - 1)]
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """P(class 1) for ``X[n, 17]`` contract-order rows; any ``n`` ≥ 0.
+
+        Batches beyond the largest bucket run as sequential largest-bucket
+        chunks — the compile cache stays bounded no matter what the
+        batcher (or a caller) hands in.
+        """
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"expected [n, {self.n_features}] contract rows, got "
+                f"{X.shape}"
+            )
+        n = X.shape[0]
+        if n == 0:
+            return np.empty((0,), np.float64)
+        top = self.buckets[-1]
+        if n > top:
+            return np.concatenate(
+                [self.predict(X[s : s + top]) for s in range(0, n, top)]
+            )
+        b = self.bucket_for(n)
+        if n < b:
+            X = np.pad(X, ((0, b - n), (0, 0)), mode="edge")
+        return np.asarray(self._impl(X))[:n]
+
+    def warmup(self, say=None) -> dict[int, float]:
+        """Compile every ladder bucket up front (example-patient rows, each
+        blocked to completion); returns per-bucket wall seconds. After
+        warmup, steady-state traffic never waits on a compile."""
+        import jax
+
+        from machine_learning_replications_tpu.data.examples import patient_row
+
+        row = patient_row()
+        times: dict[int, float] = {}
+        for b in self.buckets:
+            t0 = time.monotonic()
+            jax.block_until_ready(
+                self._impl(np.repeat(row, b, axis=0))
+            )
+            times[b] = time.monotonic() - t0
+            if say is not None:
+                say(f"warmup bucket {b}: {times[b]:.2f}s")
+        self.warm = True
+        return times

@@ -1,0 +1,242 @@
+"""Serving metrics — the observability half of the serving contract.
+
+A model server that sheds load needs numbers to prove the shedding was
+correct: offered vs served throughput, latency quantiles, how deep the
+admission queue ran, and how much device work the bucket ladder wasted on
+padding. Everything here is stdlib + numpy, one lock per instrument, and
+renders in Prometheus text exposition format on ``/metrics``
+(``serve.server``); ``snapshot()`` is the same data as a dict for JSON
+consumers and tests.
+
+Quantiles come from a bounded ring of recent observations (default 8192)
+rather than streaming sketches: a serving process answering p99 questions
+about *recent* traffic wants a sliding window anyway, and the ring keeps
+the memory bound explicit (one f64 per slot) — the same
+bounded-over-unbounded discipline as the batcher's admission queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+
+class Counter:
+    """Monotonic counter (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram plus a quantile ring.
+
+    ``buckets`` are upper bounds (``le``) in ascending order; an implicit
+    +Inf bucket catches the tail. ``quantile`` interpolates over the ring
+    of the most recent ``ring_size`` observations (numpy percentile,
+    linear interpolation), so p50/p95/p99 track current traffic instead of
+    the process's whole life.
+    """
+
+    def __init__(self, buckets: Sequence[float], ring_size: int = 8192) -> None:
+        self._lock = threading.Lock()
+        self._bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self._bounds) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._ring = np.empty(ring_size, np.float64)
+        self._ring_n = 0  # total ever written; ring index = n % size
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            i = 0
+            while i < len(self._bounds) and v > self._bounds[i]:
+                i += 1
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._ring[self._ring_n % self._ring.shape[0]] = v
+            self._ring_n += 1
+
+    def quantile(self, q: float | Sequence[float]):
+        """Quantile(s) in [0, 1] over the recent-observation ring
+        (NaN when empty)."""
+        with self._lock:
+            n = min(self._ring_n, self._ring.shape[0])
+            window = self._ring[:n].copy()
+        if n == 0:
+            return (
+                float("nan")
+                if isinstance(q, float)
+                else [float("nan")] * len(list(q))
+            )
+        out = np.percentile(window, np.asarray(q, np.float64) * 100.0)
+        return float(out) if isinstance(q, float) else [float(x) for x in out]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            cum, acc = [], 0
+            for c in self._counts:
+                acc += c
+                cum.append(acc)
+            return {
+                "buckets": {
+                    **{str(b): cum[i] for i, b in enumerate(self._bounds)},
+                    "+Inf": cum[-1],
+                },
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+
+# Latency buckets in seconds: sub-ms through 10 s, roughly log-spaced — wide
+# enough for a cold-compile outlier, fine enough to see micro-batch wait.
+LATENCY_BUCKETS_S = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class ServingMetrics:
+    """The fixed instrument set the serving layer exports.
+
+    ``requests_total`` counts admitted requests; ``shed_total`` counts
+    admission-queue rejections (the explicit "overloaded" replies);
+    ``errors_total`` counts requests that failed inside the engine;
+    ``timeouts_total`` counts admitted requests whose client deadline
+    expired before the batcher reached them (replied 504 and cancelled, so
+    the engine never computes them). Batch instruments are per flushed
+    micro-batch: ``batch_size`` is real rows, ``padding_waste`` is
+    ``bucket − real rows`` (device rows computed and thrown away — the
+    cost of the bounded compile cache).
+    """
+
+    def __init__(
+        self,
+        batch_buckets: Sequence[float] = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+    ) -> None:
+        self.requests_total = Counter()
+        self.shed_total = Counter()
+        self.errors_total = Counter()
+        self.timeouts_total = Counter()
+        self.batches_total = Counter()
+        self.queue_depth = Gauge()
+        self.latency = Histogram(LATENCY_BUCKETS_S)
+        self.batch_size = Histogram(batch_buckets)
+        self.padding_waste = Histogram(batch_buckets)
+        self.started_at = time.time()
+
+    def snapshot(self) -> dict:
+        # Empty-window quantiles become None (JSON null): a bare NaN token
+        # is not strict JSON, and this dict feeds /metrics?format=json.
+        p50, p95, p99 = (
+            None if v != v else v
+            for v in self.latency.quantile((0.5, 0.95, 0.99))
+        )
+        lat = self.latency.snapshot()
+        return {
+            "requests_total": self.requests_total.value,
+            "shed_total": self.shed_total.value,
+            "errors_total": self.errors_total.value,
+            "timeouts_total": self.timeouts_total.value,
+            "batches_total": self.batches_total.value,
+            "queue_depth": self.queue_depth.value,
+            "latency_seconds": {
+                "p50": p50, "p95": p95, "p99": p99,
+                "sum": lat["sum"], "count": lat["count"],
+            },
+            "batch_size": self.batch_size.snapshot(),
+            "padding_waste": self.padding_waste.snapshot(),
+            "uptime_seconds": time.time() - self.started_at,
+        }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of every instrument."""
+        lines: list[str] = []
+
+        def counter(name: str, help_: str, v: float) -> None:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {v}")
+
+        def histogram(name: str, help_: str, h: Histogram) -> None:
+            snap = h.snapshot()
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} histogram")
+            for le, c in snap["buckets"].items():
+                lines.append(f'{name}_bucket{{le="{le}"}} {c}')
+            lines.append(f"{name}_sum {snap['sum']}")
+            lines.append(f"{name}_count {snap['count']}")
+
+        counter("serve_requests_total", "Admitted predict requests.",
+                self.requests_total.value)
+        counter("serve_shed_total",
+                "Requests rejected by admission control (overloaded).",
+                self.shed_total.value)
+        counter("serve_errors_total", "Requests failed inside the engine.",
+                self.errors_total.value)
+        counter("serve_timeouts_total",
+                "Admitted requests whose deadline expired before flush "
+                "(504, cancelled unserved).",
+                self.timeouts_total.value)
+        counter("serve_batches_total", "Micro-batches flushed to the engine.",
+                self.batches_total.value)
+        lines.append("# HELP serve_queue_depth Admission queue depth after "
+                     "the last flush.")
+        lines.append("# TYPE serve_queue_depth gauge")
+        lines.append(f"serve_queue_depth {self.queue_depth.value}")
+        # Quantiles live under their OWN family name: summary-style samples
+        # inside the histogram family (metadata after samples / duplicate
+        # family) make the whole exposition unparseable to a strict
+        # Prometheus scraper.
+        lines.append("# HELP serve_request_latency_quantile_seconds "
+                     "Recent-window latency quantiles (ring of last 8192).")
+        lines.append("# TYPE serve_request_latency_quantile_seconds gauge")
+        for q, v in zip((0.5, 0.95, 0.99),
+                        self.latency.quantile((0.5, 0.95, 0.99))):
+            val = "NaN" if v != v else repr(v)
+            lines.append(
+                f'serve_request_latency_quantile_seconds{{quantile="{q}"}} '
+                f"{val}"
+            )
+        histogram("serve_request_latency_seconds",
+                  "Request latency from enqueue to flush completion "
+                  "(excludes HTTP reply serialization).",
+                  self.latency)
+        histogram("serve_batch_size_rows", "Real rows per flushed micro-batch.",
+                  self.batch_size)
+        histogram("serve_padding_waste_rows",
+                  "Pad rows per flushed micro-batch (bucket minus real rows).",
+                  self.padding_waste)
+        return "\n".join(lines) + "\n"

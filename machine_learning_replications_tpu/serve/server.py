@@ -1,0 +1,267 @@
+"""Stdlib HTTP front end for the serving layer.
+
+Endpoints (``ThreadingHTTPServer`` — one thread per connection feeding the
+shared micro-batcher, no third-party dependencies):
+
+  POST /predict   body = the 17-variable patient JSON (``predict_hf.py:5-27``,
+                  same validation as ``cli.py predict --patient``) → 200
+                  ``{"probability": p, "text": "Probability of progressive
+                  HF is: XX.XX %"}``. 400 on contract violations, 413 on
+                  oversized bodies (never read into memory), 503
+                  ``{"error": "overloaded"}`` when admission control sheds,
+                  504 when an admitted request misses the request deadline
+                  (it is cancelled, so the engine never computes it).
+  GET  /healthz   liveness/readiness: params family, bucket ladder, warm
+                  flag, queue depth.
+  GET  /metrics   Prometheus text exposition (``?format=json`` for the
+                  same data as JSON) — ``serve.metrics``.
+
+``ServerHandle.shutdown`` is the graceful path: stop accepting, drain the
+batcher (admitted requests are never dropped), then stop the listener.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as FuturesTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+
+class _Server(ThreadingHTTPServer):
+    # Kernel accept backlog. The socketserver default (5) drops SYNs under
+    # open-loop bursts, so clients stall in 1 s / 3 s / 7 s TCP retransmit
+    # and overload shows up as silent kernel drops — it must instead reach
+    # the bounded batcher queue, whose explicit 503 is the shedding
+    # contract this layer is built around.
+    request_queue_size = 128
+
+from machine_learning_replications_tpu.serve.batcher import (
+    MicroBatcher,
+    Overloaded,
+)
+from machine_learning_replications_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    BucketedPredictEngine,
+)
+from machine_learning_replications_tpu.serve.metrics import ServingMetrics
+
+# predict_hf.py:38-40 — the single-patient CLI prints exactly this line;
+# the HTTP reply carries it verbatim so the serving layer inherits the
+# output contract.
+OUTPUT_CONTRACT = "Probability of progressive HF is: {:.2f} %"
+
+
+class ServerHandle:
+    """A running serving stack: engine + batcher + metrics + HTTP listener."""
+
+    def __init__(self, engine, batcher, metrics, httpd) -> None:
+        self.engine = engine
+        self.batcher = batcher
+        self.metrics = metrics
+        self.httpd = httpd
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def start_background(self) -> "ServerHandle":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Graceful stop: close admission (draining by default), then stop
+        the HTTP loop. Safe to call more than once."""
+        self.batcher.close(drain=drain)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
+    batcher, metrics, engine = handle.batcher, handle.metrics, handle.engine
+
+    class Handler(BaseHTTPRequestHandler):
+        # Persistent connections keep the loadgen's closed loop honest
+        # (no per-request TCP handshake in the measured latency).
+        protocol_version = "HTTP/1.1"
+        # Socket-level read timeout (StreamRequestHandler applies this per
+        # connection): without it, every idle keep-alive client pins a
+        # handler thread forever in readline(). BaseServer.timeout would
+        # NOT do this — serve_forever ignores it. Also bounds how long a
+        # lingering idle connection can delay the drain-join in shutdown.
+        timeout = 5.0
+        # A patient JSON is ~600 bytes; anything near this bound is not a
+        # legitimate request, and an uncapped read would let one oversized
+        # POST buffer past every bound the admission queue enforces.
+        max_body_bytes = 64 * 1024
+
+        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, code: int, obj) -> None:
+            self._reply(
+                code, json.dumps(obj).encode(), "application/json"
+            )
+
+        def do_GET(self) -> None:  # noqa: N802 (http.server API)
+            url = urlparse(self.path)
+            if url.path == "/healthz":
+                self._json(200, {
+                    "status": "ok",
+                    "params": type(engine.params).__name__,
+                    "buckets": list(engine.buckets),
+                    "warm": engine.warm,
+                    "queue_depth": batcher.queue_depth,
+                })
+            elif url.path == "/metrics":
+                fmt = parse_qs(url.query).get("format", ["prometheus"])[0]
+                if fmt == "json":
+                    self._json(200, metrics.snapshot())
+                else:
+                    self._reply(
+                        200, metrics.render_prometheus().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+            else:
+                self._json(404, {"error": f"no such path: {url.path}"})
+
+        def do_POST(self) -> None:  # noqa: N802 (http.server API)
+            if urlparse(self.path).path != "/predict":
+                # Unread body on a keep-alive connection would be parsed
+                # as the NEXT request line — close instead of desyncing.
+                self.close_connection = True
+                self._json(404, {"error": f"no such path: {self.path}"})
+                return
+            from machine_learning_replications_tpu.data.examples import (
+                validate_patient,
+            )
+
+            try:
+                length = int(self.headers.get("Content-Length", ""))
+            except ValueError:
+                length = -1
+            if length < 0:
+                # Missing, unparseable, or negative Content-Length: the
+                # body length is unknowable (rfile.read(negative) would
+                # even read to EOF, stalling until the socket timeout),
+                # so the connection cannot be resynced either — close it.
+                self.close_connection = True
+                self._json(400, {"error": "missing or invalid Content-Length"})
+                return
+            try:
+                if length > self.max_body_bytes:
+                    # Don't read a body we've rejected: close the
+                    # connection instead of draining it.
+                    self.close_connection = True
+                    self._json(413, {
+                        "error": f"body exceeds {self.max_body_bytes} bytes",
+                    })
+                    return
+                patient = json.loads(self.rfile.read(length) or b"{}")
+                row = validate_patient(patient)
+            except (ValueError, json.JSONDecodeError) as exc:
+                self._json(400, {"error": str(exc)})
+                return
+            try:
+                future = batcher.submit(row[0])
+            except Overloaded:
+                self._json(503, {"error": "overloaded"})
+                return
+            except RuntimeError as exc:  # closed during shutdown
+                self._json(503, {"error": str(exc)})
+                return
+            try:
+                prob = future.result(timeout=request_timeout_s)
+            except FuturesTimeout:
+                # Cancel so a still-queued request is dropped at flush time
+                # (batcher skips cancelled entries) — otherwise every
+                # deadline miss still burns an engine slot computing an
+                # answer nobody reads, compounding the overload.
+                future.cancel()
+                metrics.timeouts_total.inc()
+                self._json(504, {
+                    "error": f"timed out after {request_timeout_s:g}s",
+                })
+                return
+            except Exception as exc:
+                self._json(500, {"error": str(exc)})
+                return
+            self._json(200, {
+                "probability": prob,
+                "text": OUTPUT_CONTRACT.format(100.0 * prob),
+            })
+
+        def log_message(self, fmt: str, *args) -> None:
+            if not quiet:
+                super().log_message(fmt, *args)
+
+    return Handler
+
+
+def make_server(
+    params,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    buckets=DEFAULT_BUCKETS,
+    max_batch_size: int | None = None,
+    max_wait_ms: float = 5.0,
+    max_queue: int = 1024,  # above the top default bucket (512): a full
+    # largest-bucket batch must be formable under saturation, or the top
+    # bucket's executable only ever runs padded
+    warmup: bool = True,
+    request_timeout_s: float = 30.0,
+    quiet: bool = True,
+    say=None,
+) -> ServerHandle:
+    """Assemble the serving stack around fitted ``params`` and bind the
+    listener (not yet serving — call ``serve_forever`` or
+    ``start_background``). ``max_batch_size`` defaults to the largest
+    bucket so a full batch pads nothing.
+
+    The listener BINDS before warmup runs: a port conflict fails in
+    milliseconds instead of after the multi-second compile bill. Warmup
+    still completes before this returns (warm standby — the first served
+    request never pays a compile); start serving first and call
+    ``engine.warmup`` yourself for observable warm=false readiness."""
+    engine = BucketedPredictEngine(params, buckets=buckets)
+    metrics = ServingMetrics(batch_buckets=engine.buckets)
+    batcher = MicroBatcher(
+        engine,
+        max_batch_size=max_batch_size or engine.buckets[-1],
+        max_wait_ms=max_wait_ms,
+        max_queue=max_queue,
+        metrics=metrics,
+    )
+    handle = ServerHandle(engine, batcher, metrics, None)
+    handler = _make_handler(handle, request_timeout_s, quiet)
+    try:
+        handle.httpd = _Server((host, port), handler)
+        # Joinable handler threads: shutdown() must be able to wait for
+        # in-flight replies to finish writing (ThreadingHTTPServer's
+        # daemon default is excluded from server_close's thread join).
+        handle.httpd.daemon_threads = False
+        if warmup:
+            engine.warmup(say=say)
+    except BaseException:
+        batcher.close(drain=False, timeout=1.0)
+        if handle.httpd is not None:
+            # The listener bound before warmup failed: release the port so
+            # a caller that catches and retries doesn't hit EADDRINUSE.
+            handle.httpd.server_close()
+        raise
+    return handle

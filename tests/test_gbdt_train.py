@@ -257,26 +257,28 @@ def test_blocked_boundary_sums_match_sequential():
     np.testing.assert_allclose(out_f, ref_f, atol=5e-3)
 
 
-def test_fused_falls_back_on_nonbinary_labels(train_data, monkeypatch):
-    """The fused fit packs labels as a bin column, which is only valid for
-    exact-0/1 labels — soft labels (well-defined under binomial deviance)
-    must fall back to the label-gather path and train identically to an
-    explicit-bins fit, not raise and not silently truncate to bits."""
+def test_fused_accepts_soft_labels(train_data, monkeypatch):
+    """Since the r5 unsorted formulation no label packing remains — each
+    stage histograms g = y − p directly — so soft labels (well-defined
+    under binomial deviance) train on the fused path itself (ADVICE r5
+    dropped the gate that routed them off it) and must match an
+    explicit-bins sorted-layout fit: identical tree structure, leaf values
+    to summation-order tolerance."""
     from machine_learning_replications_tpu.ops import binning
 
     X, y = train_data
     monkeypatch.setattr(gbdt, "DEVICE_BINNING_MIN_ROWS", 1)
     y_soft = np.where(y > 0.5, 0.9, 0.1)
     cfg = GBDTConfig(n_estimators=5, splitter="hist", n_bins=32)
-    fell_back, _ = gbdt.fit(X, y_soft, cfg)
+    fused, _ = gbdt.fit(X, y_soft, cfg)
     explicit, _ = gbdt.fit(
         X, y_soft, cfg, bins=binning.bin_features_device(X, 32)
     )
     np.testing.assert_array_equal(
-        np.asarray(fell_back.feature), np.asarray(explicit.feature)
+        np.asarray(fused.feature), np.asarray(explicit.feature)
     )
     np.testing.assert_allclose(
-        np.asarray(fell_back.value), np.asarray(explicit.value), rtol=1e-6
+        np.asarray(fused.value), np.asarray(explicit.value), rtol=1e-6
     )
 
 

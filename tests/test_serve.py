@@ -1,0 +1,640 @@
+"""Serving layer (serve/): parity, compile-cache bound, flush policy,
+admission control, graceful drain, HTTP front end, and the load generator.
+
+The acceptance contract (ISSUE 1): served probabilities identical to the
+single-patient CLI path, at most one XLA compile per bucket size, a
+bounded queue with measured shed behavior under overload, and p50/p95/p99
++ throughput in a SERVE_BENCH artifact. Everything here is CPU-runnable
+under the tier-1 marker set; the shipped-pickle leg (printing 27.09 %)
+skips where the reference artifact is absent, and a live sklearn-imported
+ensemble covers the same route unconditionally.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.data.examples import (
+    EXAMPLE_PATIENT,
+    patient_row,
+)
+from machine_learning_replications_tpu.serve import (
+    BucketedPredictEngine,
+    MicroBatcher,
+    Overloaded,
+    ServingMetrics,
+    make_server,
+)
+
+_HAVE_REFERENCE_PKL = os.path.exists(
+    "/root/reference/Machine Learning for Predicting Heart Failure "
+    "Progression/hf_predict_model.pkl"
+)
+
+
+@pytest.fixture(scope="module")
+def stacking_params():
+    """A live sklearn-fitted stacking ensemble imported into our pytrees —
+    the same import route as the shipped pickle, available everywhere."""
+    from sklearn.ensemble import GradientBoostingClassifier, StackingClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    from machine_learning_replications_tpu.persist import import_stacking
+
+    rng = np.random.default_rng(7)
+    n, f = 300, 17
+    X = rng.normal(size=(n, f))
+    X[:, :10] = (X[:, :10] > 0.3).astype(float)
+    y = (X @ rng.normal(size=f) + rng.normal(size=n) > 0.2).astype(float)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = StackingClassifier(
+            estimators=[
+                ("svc", make_pipeline(
+                    StandardScaler(),
+                    SVC(class_weight="balanced", probability=True,
+                        random_state=2020),
+                )),
+                ("gbc", GradientBoostingClassifier(
+                    n_estimators=20, max_depth=1, random_state=2020)),
+                ("lg", LogisticRegression(
+                    class_weight="balanced", penalty="l1",
+                    solver="liblinear")),
+            ],
+            final_estimator=LogisticRegression(class_weight="balanced"),
+        ).fit(X, y)
+    return import_stacking(clf)
+
+
+@pytest.fixture(scope="module")
+def query_rows():
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(70, 17))
+    X[:, :10] = (X[:, :10] > 0.3).astype(float)
+    return X
+
+
+# ---------------------------------------------------------------------------
+# engine: bucket ladder, parity, compile-count bound
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_selection(stacking_params):
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8, 64))
+    assert [eng.bucket_for(n) for n in (1, 2, 8, 9, 64, 65, 10_000)] == [
+        1, 8, 8, 64, 64, 64, 64,
+    ]
+    with pytest.raises(ValueError):
+        BucketedPredictEngine(stacking_params, buckets=())
+    with pytest.raises(ValueError):
+        BucketedPredictEngine(stacking_params, buckets=(0, 4))
+    with pytest.raises(TypeError):
+        BucketedPredictEngine(object())
+
+
+def test_engine_parity_and_padding_neutrality(stacking_params, query_rows):
+    """Two layers of the parity contract: (1) pad rows are bit-neutral —
+    any two batch sizes landing in the same bucket run the same compiled
+    program and agree exactly on shared rows; (2) the bucketed path
+    matches the direct eager predict to float tolerance (XLA fusion may
+    regroup last-ulp float ops vs op-by-op dispatch)."""
+    from machine_learning_replications_tpu.models import stacking
+
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8, 64))
+    direct = np.asarray(stacking.predict_proba1(stacking_params, query_rows))
+    for n in (1, 2, 7, 8, 9, 63, 64, 70):
+        got = eng.predict(query_rows[:n])
+        assert got.shape == (n,)
+        np.testing.assert_allclose(got, direct[:n], rtol=1e-12, atol=1e-15)
+    # bit-for-bit padding neutrality within each bucket: 2 and 7 rows both
+    # pad into the 8-bucket; 9 and 63 both into the 64-bucket
+    np.testing.assert_array_equal(
+        eng.predict(query_rows[:7])[:2], eng.predict(query_rows[:2])
+    )
+    np.testing.assert_array_equal(
+        eng.predict(query_rows[:63])[:9], eng.predict(query_rows[:9])
+    )
+
+
+def test_engine_compile_count_bound(stacking_params, query_rows):
+    """At most ONE XLA compile per ladder bucket, no matter what batch
+    sizes traffic presents — the trace counter increments exactly when jit
+    traces (once per compile)."""
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8, 64))
+    eng.warmup()
+    assert eng.trace_counts == {1: 1, 8: 1, 64: 1}
+    for n in (1, 2, 3, 5, 7, 8, 9, 30, 64, 65, 70):
+        eng.predict(query_rows[:n])
+    # mixed traffic added zero new traces: the cache is bounded and warm
+    assert eng.trace_counts == {1: 1, 8: 1, 64: 1}
+
+
+def test_engine_oversize_batch_chunks(stacking_params, query_rows):
+    from machine_learning_replications_tpu.models import stacking
+
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8))
+    got = eng.predict(query_rows)  # 70 rows through 8-row chunks
+    direct = np.asarray(stacking.predict_proba1(stacking_params, query_rows))
+    np.testing.assert_allclose(got, direct, rtol=1e-12, atol=1e-15)
+    assert set(eng.trace_counts) <= {1, 8}
+    assert eng.predict(np.empty((0, 17))).shape == (0,)
+    with pytest.raises(ValueError, match="contract rows"):
+        eng.predict(np.zeros((3, 5)))
+
+
+# ---------------------------------------------------------------------------
+# batcher: flush policy, admission control, drain
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    """Deterministic engine double: mean of each row, optional delay/block."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.batches: list[int] = []
+        self.release = threading.Event()
+        self.release.set()
+
+    def predict(self, X):
+        self.release.wait(5.0)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.batches.append(X.shape[0])
+        return X.mean(axis=1)
+
+    def bucket_for(self, n):
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+
+def test_batcher_flushes_full_batch_immediately():
+    eng = _StubEngine()
+    m = ServingMetrics()
+    b = MicroBatcher(eng, max_batch_size=4, max_wait_ms=10_000, max_queue=64,
+                     metrics=m)
+    try:
+        eng.release.clear()  # hold the engine so one full batch accumulates
+        futs = [b.submit(np.full(17, i)) for i in range(4)]
+        eng.release.set()
+        got = [f.result(timeout=5.0) for f in futs]
+        assert got == [float(i) for i in range(4)]
+        # a full batch must flush well before the (absurd) 10 s wait bound
+        assert 4 in eng.batches
+        assert m.requests_total.value == 4
+        assert m.batches_total.value >= 1
+    finally:
+        b.close()
+
+
+def test_batcher_flush_timeout_single_request():
+    eng = _StubEngine()
+    b = MicroBatcher(eng, max_batch_size=64, max_wait_ms=30.0, max_queue=64)
+    try:
+        t0 = time.monotonic()
+        fut = b.submit(np.full(17, 2.0))
+        assert fut.result(timeout=5.0) == 2.0
+        elapsed = time.monotonic() - t0
+        # the lone request waited out (roughly) the coalescing window, not
+        # the full-batch count — generous upper bound for CI jitter
+        assert elapsed < 5.0
+        assert eng.batches == [1]
+    finally:
+        b.close()
+
+
+def test_batcher_sheds_when_queue_full():
+    eng = _StubEngine()
+    m = ServingMetrics()
+    b = MicroBatcher(eng, max_batch_size=4, max_wait_ms=50.0, max_queue=3,
+                     metrics=m)
+    try:
+        eng.release.clear()  # wedge the engine: the queue can only grow
+        futs = []
+        shed = 0
+        for i in range(12):
+            try:
+                futs.append(b.submit(np.full(17, i)))
+            except Overloaded:
+                shed += 1
+        assert shed > 0, "a bounded queue must shed under a wedged engine"
+        assert m.shed_total.value == shed
+        # admitted requests still complete once the engine unwedges
+        eng.release.set()
+        for f in futs:
+            assert isinstance(f.result(timeout=5.0), float)
+    finally:
+        b.close()
+
+
+def test_batcher_graceful_drain():
+    eng = _StubEngine(delay_s=0.02)
+    b = MicroBatcher(eng, max_batch_size=2, max_wait_ms=5_000, max_queue=64)
+    futs = [b.submit(np.full(17, i)) for i in range(7)]
+    b.close(drain=True)  # stops admission, flushes everything admitted
+    assert all(f.done() for f in futs)
+    assert [f.result() for f in futs] == [float(i) for i in range(7)]
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(np.full(17, 0.0))
+
+
+def test_batcher_close_without_drain_fails_pending():
+    eng = _StubEngine()
+    eng.release.clear()
+    b = MicroBatcher(eng, max_batch_size=64, max_wait_ms=5_000, max_queue=64)
+    futs = [b.submit(np.full(17, i)) for i in range(3)]
+    eng.release.set()
+    b.close(drain=False, timeout=5.0)
+    for f in futs:
+        if not f.done() or f.exception() is not None:
+            continue
+        # a fast flush may legitimately win the race; values stay correct
+        assert isinstance(f.result(), float)
+
+
+def test_batcher_skips_cancelled_requests():
+    """A request cancelled while queued (the server's deadline-expiry
+    path) must be dropped at flush time — the engine never computes it —
+    while its batchmates still get answers."""
+    eng = _StubEngine()
+    # 10 s wait bound + batch of 4: nothing flushes until the 4th submit,
+    # so the cancel below deterministically lands while f1 is queued.
+    b = MicroBatcher(eng, max_batch_size=4, max_wait_ms=10_000, max_queue=64)
+    try:
+        f0 = b.submit(np.full(17, 0.0))
+        f1 = b.submit(np.full(17, 1.0))
+        f2 = b.submit(np.full(17, 2.0))
+        assert f1.cancel(), "a queued future must be cancellable"
+        f3 = b.submit(np.full(17, 3.0))  # fills the batch -> flush
+        assert f0.result(timeout=5.0) == 0.0
+        assert f2.result(timeout=5.0) == 2.0
+        assert f3.result(timeout=5.0) == 3.0
+        assert f1.cancelled()
+        # only the three live rows reached the engine
+        assert sum(eng.batches) == 3
+    finally:
+        b.close()
+
+
+def test_batcher_engine_error_propagates():
+    class Boom:
+        def predict(self, X):
+            raise RuntimeError("boom")
+
+    m = ServingMetrics()
+    b = MicroBatcher(Boom(), max_batch_size=2, max_wait_ms=1.0, metrics=m)
+    try:
+        fut = b.submit(np.full(17, 1.0))
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=5.0)
+        assert m.errors_total.value == 1
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_quantiles_and_render():
+    m = ServingMetrics()
+    for v in np.linspace(0.001, 0.1, 1000):
+        m.latency.observe(float(v))
+    p50, p95, p99 = m.latency.quantile((0.5, 0.95, 0.99))
+    assert 0.045 < p50 < 0.055
+    assert 0.09 < p95 < 0.1
+    assert p95 < p99 <= 0.1
+    m.requests_total.inc(3)
+    m.batch_size.observe(4)
+    m.padding_waste.observe(4)
+    text = m.render_prometheus()
+    assert "serve_requests_total 3" in text
+    assert 'serve_request_latency_quantile_seconds{quantile="0.99"}' in text
+    assert "serve_batch_size_rows_count 1" in text
+    # Exposition validity: every family declares HELP/TYPE exactly once,
+    # and no samples for a family appear before its TYPE line (a strict
+    # Prometheus scraper rejects the whole page otherwise).
+    lines = text.splitlines()
+    for fam in (
+        "serve_request_latency_seconds",
+        "serve_request_latency_quantile_seconds",
+    ):
+        type_lines = [l for l in lines if l.startswith(f"# TYPE {fam} ")]
+        assert len(type_lines) == 1
+        first_sample = next(
+            i for i, l in enumerate(lines)
+            if l.startswith(fam)
+        )
+        assert lines.index(type_lines[0]) < first_sample
+    snap = m.snapshot()
+    assert snap["requests_total"] == 3
+    assert snap["latency_seconds"]["count"] == 1000
+
+
+def test_metrics_snapshot_is_strict_json_before_traffic():
+    """Empty-window quantiles must serialize as null, not a bare NaN token
+    (which json.dumps emits and every strict JSON parser rejects)."""
+    snap = ServingMetrics().snapshot()
+    assert snap["latency_seconds"]["p50"] is None
+    json.loads(json.dumps(snap))  # round-trips under the strict parser
+
+
+# ---------------------------------------------------------------------------
+# HTTP server end-to-end (real sockets, loopback)
+# ---------------------------------------------------------------------------
+
+
+def _post(url, obj, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+@pytest.fixture()
+def served(stacking_params):
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), max_wait_ms=2.0,
+        max_queue=32,
+    ).start_background()
+    host, port = handle.address
+    yield handle, f"http://{host}:{port}"
+    handle.shutdown()
+
+
+def test_http_predict_healthz_metrics(served, stacking_params):
+    from machine_learning_replications_tpu.models import stacking
+
+    handle, url = served
+    status, body = _post(url + "/predict", dict(EXAMPLE_PATIENT))
+    assert status == 200
+    direct = float(stacking.predict_proba1(stacking_params, patient_row())[0])
+    assert body["probability"] == direct  # served == single-patient path
+    assert body["text"] == (
+        f"Probability of progressive HF is: {100.0 * direct:.2f} %"
+    )
+
+    status, body = _get(url + "/healthz")
+    health = json.loads(body)
+    assert status == 200 and health["status"] == "ok"
+    assert health["warm"] is True and health["buckets"] == [1, 8]
+
+    status, text = _get(url + "/metrics")
+    assert status == 200
+    assert "serve_requests_total" in text
+    status, body = _get(url + "/metrics?format=json")
+    assert json.loads(body)["requests_total"] >= 1
+
+
+def test_http_rejects_contract_violations(served):
+    _, url = served
+    for bad in (
+        {"Not_A_Variable": 1},                       # unknown key
+        {"Dyspnea": 1},                              # missing 16 variables
+        {**EXAMPLE_PATIENT, "Dyspnea": "severe"},    # non-numeric
+        # json.loads admits the NaN/Infinity tokens; the contract must not
+        {**EXAMPLE_PATIENT, "Ejection_Fraction": float("nan")},
+        {**EXAMPLE_PATIENT, "Ejection_Fraction": float("inf")},
+        [1, 2, 3],                                   # not an object
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url + "/predict", bad)
+        assert ei.value.code == 400
+        ei.value.read()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(url + "/nope")
+    assert ei.value.code == 404
+    ei.value.read()
+    # Oversized body: rejected from the Content-Length header alone (413),
+    # never buffered. The server may close the connection before the
+    # client finishes streaming, which some stacks surface as a socket
+    # error rather than the status line — both prove the cap.
+    big = json.dumps({**EXAMPLE_PATIENT, "pad": "x" * (1 << 17)})
+    try:
+        req = urllib.request.Request(
+            url + "/predict", data=big.encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=30.0).read()
+        raise AssertionError("oversized body must not be accepted")
+    except urllib.error.HTTPError as e:
+        assert e.code == 413
+        e.read()
+    except (urllib.error.URLError, ConnectionError):
+        pass
+
+
+def test_http_404_with_body_closes_connection(served):
+    """A POST to an unknown path leaves its body unread; the server must
+    close the keep-alive connection, or the stale bytes would be parsed as
+    the next request line (connection desync)."""
+    import socket
+
+    handle, url = served
+    host, port = handle.address
+    body = json.dumps(dict(EXAMPLE_PATIENT)).encode()
+    with socket.create_connection((host, port), timeout=10) as s:
+        s.sendall(
+            b"POST /predic HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: %d\r\n\r\n%b" % (len(body), body)
+        )
+        chunks = []
+        while True:  # read to EOF — blocks past the timeout if the
+            b = s.recv(65536)  # server wrongly kept the connection open
+            if not b:
+                break
+            chunks.append(b)
+        reply = b"".join(chunks)
+        assert b"404" in reply.split(b"\r\n", 1)[0]
+
+
+def test_http_concurrent_requests_batch(served, stacking_params):
+    """Concurrent clients coalesce into micro-batches; every reply equals
+    the single-row path."""
+    from machine_learning_replications_tpu.models import stacking
+
+    handle, url = served
+    direct = float(stacking.predict_proba1(stacking_params, patient_row())[0])
+    results, errs = [], []
+
+    def one():
+        try:
+            _, body = _post(url + "/predict", dict(EXAMPLE_PATIENT))
+            results.append(body["probability"])
+        except Exception as exc:  # pragma: no cover - diagnostic aid
+            errs.append(exc)
+
+    threads = [threading.Thread(target=one) for _ in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert results == [direct] * 24
+    assert handle.metrics.batches_total.value >= 1
+
+
+# ---------------------------------------------------------------------------
+# load generator (in-process, against a real served instance)
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_closed_loop_artifact(served, tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    _, url = served
+    out = tmp_path / "SERVE_BENCH_test.json"
+    rc = loadgen.main([
+        "--url", url, "--mode", "closed", "--concurrency", "4",
+        "--duration", "1.0", "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["kind"] == "serve_bench"
+    assert art["n_ok"] > 0 and art["n_err"] == 0
+    assert art["achieved_qps"] > 0
+    for q in ("p50", "p95", "p99"):
+        assert art["latency_ms"][q] > 0
+
+
+def test_loadgen_open_loop_sheds_under_overload(stacking_params, tmp_path):
+    """Open-loop overload against a tiny queue and a deliberately slowed
+    engine must produce explicit 503 sheds, counted in the artifact and in
+    the server's metrics — bounded-queue behavior, measured."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), max_wait_ms=1.0,
+        max_queue=2,
+    ).start_background()
+    try:
+        # slow every flush down so the offered rate must overrun the queue
+        real_predict = handle.engine.predict
+
+        def slow_predict(X):
+            time.sleep(0.05)
+            return real_predict(X)
+
+        handle.batcher._engine = type(
+            "Slow", (), {
+                "predict": staticmethod(slow_predict),
+                "bucket_for": staticmethod(handle.engine.bucket_for),
+            },
+        )()
+        host, port = handle.address
+        out = tmp_path / "SERVE_BENCH_overload.json"
+        rc = loadgen.main([
+            "--url", f"http://{host}:{port}", "--mode", "open",
+            "--qps", "200", "--duration", "1.0", "--out", str(out),
+        ])
+        assert rc == 0
+        art = json.loads(out.read_text())
+        assert art["n_shed"] > 0, art
+        assert art["shed_rate"] > 0
+        assert handle.metrics.shed_total.value == art["n_shed"]
+        assert art["n_ok"] > 0  # shedding, not collapse: admitted work completes
+    finally:
+        handle.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# full-pipeline and shipped-pickle parity with the CLI path
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pipeline_params():
+    """A small but real fit_pipeline model (fast config, synthetic rows)."""
+    from machine_learning_replications_tpu.config import ExperimentConfig
+    from machine_learning_replications_tpu.data import make_cohort
+    from machine_learning_replications_tpu.models import pipeline
+
+    cfg = ExperimentConfig.from_json(json.dumps({
+        "gbdt": {"n_estimators": 5},
+        "svc": {"platt_cv": 2, "max_iter": 2000},
+        "stacking": {"cv_folds": 2},
+        "select": {"cv_folds": 3, "n_alphas": 20},
+    }))
+    X, y, _ = make_cohort(n=160, seed=2020, missing_rate=0.03)
+    params, _ = pipeline.fit_pipeline(X, y, cfg)
+    return params
+
+
+def test_pipeline_engine_matches_cli_route(pipeline_params, query_rows):
+    """Served probabilities through a full-pipeline checkpoint equal the
+    CLI's predict --model route (pipeline_predict_proba1_contract) for the
+    example patient and for varied batched rows."""
+    from machine_learning_replications_tpu.models import pipeline
+
+    eng = BucketedPredictEngine(pipeline_params, buckets=(1, 8))
+    eng.warmup()
+    x = patient_row()
+    cli_prob = float(
+        pipeline.pipeline_predict_proba1_contract(pipeline_params, x)[0]
+    )
+    served = eng.predict(x)
+    np.testing.assert_array_equal(served, [cli_prob])
+
+    batch = np.asarray(
+        pipeline.pipeline_predict_proba1_contract(
+            pipeline_params, query_rows[:13]
+        )
+    )
+    np.testing.assert_allclose(
+        eng.predict(query_rows[:13]), batch, rtol=1e-12, atol=1e-15
+    )
+    # compile bound holds on the pipeline route too
+    assert eng.trace_counts == {1: 1, 8: 1}
+
+
+@pytest.mark.skipif(not _HAVE_REFERENCE_PKL, reason="reference pkl absent")
+def test_shipped_pickle_served_equals_cli(capsys):
+    """The acceptance example: the shipped reference pickle served through
+    the engine prints the same 'Probability of progressive HF is: 27.09 %'
+    contract line as `cli.py predict` — bit-for-bit equal probability."""
+    from machine_learning_replications_tpu import cli
+    from machine_learning_replications_tpu.persist import (
+        load_inference_params,
+    )
+    from machine_learning_replications_tpu.serve.server import OUTPUT_CONTRACT
+
+    assert cli.main(["predict"]) == 0
+    cli_line = capsys.readouterr().out.strip()
+
+    params = load_inference_params()
+    eng = BucketedPredictEngine(params, buckets=(1, 8))
+    prob = float(eng.predict(patient_row())[0])
+    assert OUTPUT_CONTRACT.format(100.0 * prob) == cli_line
+    assert "27.09" in cli_line  # SURVEY.md §2.3 pinned example output

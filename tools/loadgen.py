@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Load generator for the serving layer — writes a SERVE_BENCH_*.json artifact.
+
+Drives a running ``python -m machine_learning_replications_tpu serve``
+instance over HTTP (stdlib urllib + threads, no dependencies) in either of
+the two canonical load models:
+
+  closed loop   --concurrency N workers, each firing its next request the
+                moment the previous reply lands — measures sustainable
+                throughput at a fixed multiprogramming level.
+  open loop     --qps R with a global schedule of send times — measures
+                behavior under an *offered* rate the server cannot slow
+                down, which is what exposes admission-control shedding
+                (closed loops self-throttle and hide it).
+
+Every request POSTs a 17-variable patient JSON (the ``predict_hf.py:5-27``
+example by default, ``--patient`` for a file) and is counted as ok
+(HTTP 200), shed (503, the batcher's explicit overload reply), or error.
+The artifact records offered/achieved qps, ok/shed/error counts, shed
+rate, and ok-latency quantiles — the serving counterpart of BENCH_*.json.
+
+Example:
+  python tools/loadgen.py --url http://127.0.0.1:8000 \\
+      --mode closed --concurrency 8 --duration 10 \\
+      --out SERVE_BENCH_r06_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _percentiles(xs: list[float], qs=(50, 95, 99)) -> dict[str, float | None]:
+    if not xs:
+        # None → JSON null: a bare NaN token would make the artifact
+        # unparseable to strict JSON consumers.
+        return {f"p{q}": None for q in qs} | {"mean": None, "max": None}
+    xs = sorted(xs)
+    out = {}
+    for q in qs:
+        # nearest-rank on the sorted sample (no numpy: tools stay stdlib)
+        i = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+        out[f"p{q}"] = xs[i]
+    out["mean"] = sum(xs) / len(xs)
+    out["max"] = xs[-1]
+    return out
+
+
+class _Tally:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.ok_latency_ms: list[float] = []
+        self.n_ok = 0
+        self.n_shed = 0
+        self.n_err = 0
+
+    def record(self, status: str, latency_ms: float) -> None:
+        with self.lock:
+            if status == "ok":
+                self.n_ok += 1
+                self.ok_latency_ms.append(latency_ms)
+            elif status == "shed":
+                self.n_shed += 1
+            else:
+                self.n_err += 1
+
+
+def _fire(url: str, body: bytes, timeout: float, tally: _Tally) -> None:
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+            status = "ok" if resp.status == 200 else "err"
+    except urllib.error.HTTPError as exc:
+        exc.read()
+        status = "shed" if exc.code == 503 else "err"
+    except Exception:
+        status = "err"
+    tally.record(status, (time.monotonic() - t0) * 1000.0)
+
+
+def run_closed(url, body, duration, concurrency, timeout, tally):
+    stop = time.monotonic() + duration
+
+    def worker():
+        while time.monotonic() < stop:
+            _fire(url, body, timeout, tally)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t0
+
+
+def run_open(url, body, duration, qps, timeout, tally):
+    """Fixed-rate schedule; each request gets its own thread so a slow
+    server cannot throttle the offered rate (the point of an open loop).
+    A bound on in-flight threads keeps a wedged server from spawning
+    without limit — beyond it, sends are counted as errors (client-side
+    overflow), never silently skipped."""
+    interval = 1.0 / qps
+    n = int(duration * qps)
+    inflight = threading.Semaphore(max(64, int(4 * qps)))
+    threads = []
+    t0 = time.monotonic()
+    for i in range(n):
+        target = t0 + i * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        if not inflight.acquire(blocking=False):
+            tally.record("err", 0.0)
+            continue
+
+        def one():
+            try:
+                _fire(url, body, timeout, tally)
+            finally:
+                inflight.release()
+
+        th = threading.Thread(target=one)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    return time.monotonic() - t0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--duration", type=float, default=10.0, help="seconds")
+    ap.add_argument(
+        "--concurrency", type=int, default=8, help="closed-loop workers"
+    )
+    ap.add_argument("--qps", type=float, default=100.0, help="open-loop rate")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--patient", help="patient JSON file (default: example)")
+    ap.add_argument("--out", default=None, help="artifact path (JSON)")
+    args = ap.parse_args(argv)
+
+    if args.patient:
+        with open(args.patient) as f:
+            patient = json.load(f)
+    else:
+        # Script-relative, not CWD-relative: the tool must find the
+        # package when invoked as /path/to/repo/tools/loadgen.py from
+        # anywhere.
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+        )
+        from machine_learning_replications_tpu.data.examples import (
+            EXAMPLE_PATIENT,
+        )
+
+        patient = EXAMPLE_PATIENT
+    body = json.dumps(patient).encode()
+
+    tally = _Tally()
+    if args.mode == "closed":
+        wall = run_closed(
+            args.url, body, args.duration, args.concurrency, args.timeout,
+            tally,
+        )
+        offered = None
+    else:
+        wall = run_open(
+            args.url, body, args.duration, args.qps, args.timeout, tally
+        )
+        offered = args.qps
+
+    n_sent = tally.n_ok + tally.n_shed + tally.n_err
+    artifact = {
+        "kind": "serve_bench",
+        "url": args.url,
+        "mode": args.mode,
+        "duration_s": round(wall, 3),
+        "concurrency": args.concurrency if args.mode == "closed" else None,
+        "offered_qps": offered,
+        "achieved_qps": round(tally.n_ok / wall, 2) if wall > 0 else 0.0,
+        "n_sent": n_sent,
+        "n_ok": tally.n_ok,
+        "n_shed": tally.n_shed,
+        "n_err": tally.n_err,
+        "shed_rate": round(tally.n_shed / n_sent, 4) if n_sent else 0.0,
+        "latency_ms": {
+            k: None if v is None else round(v, 3)
+            for k, v in _percentiles(tally.ok_latency_ms).items()
+        },
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    line = json.dumps(artifact, indent=1)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"artifact written to {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

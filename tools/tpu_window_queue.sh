@@ -29,8 +29,10 @@ import json, subprocess, sys
 try:
     d = json.load(open("BENCH_manual_r05_tpu.json"))
     c4 = (d.get("configs") or {}).get("4", {})
-    if c4.get("vs_baseline") and "tpu" in str(c4.get("device", "")).lower() \
-            or "TPU" in str(c4.get("device", "")):
+    # A falsy vs_baseline (errored leg) must never skip the re-measure:
+    # the old `A and B or C` parsed as `(A and B) or C` and skipped on any
+    # TPU device string alone (ADVICE r5).
+    if c4.get("vs_baseline") and "tpu" in str(c4.get("device", "")).lower():
         print("c4 already captured on TPU; skipping standalone leg")
         sys.exit(0)
 except Exception as e:
