@@ -117,6 +117,29 @@ def log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+def _bench_manifest(args) -> dict:
+    """Run-provenance manifest for the artifact (obs.journal.run_manifest —
+    git sha, versions, config hash). jax-import-free by that module's
+    design, so the orchestrator's never-imports-jax contract holds; the
+    config hash binds the artifact to this invocation's knobs."""
+    sys.path.insert(0, REPO)
+    try:
+        from machine_learning_replications_tpu.obs.journal import run_manifest
+
+        knobs = {
+            k: v for k, v in sorted(vars(args).items())
+            if k not in ("leg", "json_out", "fn")
+        }
+        return run_manifest(
+            command="bench", config_json=json.dumps(knobs, sort_keys=True),
+        )
+    except Exception as e:  # a manifest must never take down the bench
+        return {"kind": "manifest", "error": f"{type(e).__name__}: {e}"}
+    finally:
+        if sys.path and sys.path[0] == REPO:
+            sys.path.pop(0)
+
+
 # ---------------------------------------------------------------------------
 # Orchestrator: environments, probes, subprocess legs
 # ---------------------------------------------------------------------------
@@ -320,6 +343,9 @@ class _RunState:
         self.degraded = True
         self.child: subprocess.Popen | None = None
         self.flushed = False
+        # Built up front (not in the signal-flush path: it shells out to
+        # git) so every BENCH_* artifact records what produced it.
+        self.manifest = _bench_manifest(args)
 
     def build_payload(self, partial: str | None = None) -> dict:
         args, results = self.args, self.results
@@ -341,6 +367,7 @@ class _RunState:
             "probe_attempts": len(self.probe_log),
             "probe_log": self.probe_log,
             "wall_s_total": round(time.perf_counter() - self.t_start, 1),
+            "manifest": self.manifest,
         }
         if partial:
             payload["partial"] = partial
@@ -388,6 +415,15 @@ class _RunState:
                      "parity_ok", "parity_checked", "degraded_cpu_fallback",
                      "probe_attempts", "wall_s_total", "partial")
         head = {k: payload[k] for k in head_keys if k in payload}
+        man = payload.get("manifest") or {}
+        if man.get("run_id"):
+            # Compact provenance on the stdout line itself (~70 bytes);
+            # the detail file carries the full manifest.
+            head["manifest"] = {
+                "run_id": man["run_id"],
+                "git_sha": (man.get("git_sha") or "")[:12] or None,
+                "config_hash": (man.get("config_hash") or "")[:12] or None,
+            }
         if detail_file:
             # Full location, not a basename: a --detail-out outside the repo
             # must still be findable from the line alone.
@@ -422,7 +458,7 @@ class _RunState:
                 return line
         # Even bare head overflowed (pathologically long strings): shed keys
         # least-important-first; never slice serialized JSON mid-token.
-        for key in ("partial", "device", "detail_file", "metric"):
+        for key in ("manifest", "partial", "device", "detail_file", "metric"):
             head.pop(key, None)
             line = json.dumps(head, separators=(",", ":"))
             if len(line) <= SUMMARY_LINE_CAP:
@@ -727,6 +763,12 @@ def device_leg(args) -> dict:
     entries_at_start = _cache_entry_count()
     import jax
 
+    from machine_learning_replications_tpu.obs import jaxmon
+
+    # Compile accounting for the artifact: how many XLA programs this leg
+    # built and what the compile wall cost — the number that separates a
+    # genuinely slow trainer from a recompile regression.
+    jaxmon.install()
     log(f"jax backend up: {_device_kind()}")
     if args.config == 1:
         rec = device_leg_inference(args)
@@ -736,6 +778,8 @@ def device_leg(args) -> dict:
         rec = device_leg_sweep(args)
     else:
         rec = device_leg_scaled(args)
+    rec["jax_compiles"] = jaxmon.compile_count()
+    rec["jax_compile_seconds"] = round(jaxmon.compile_seconds(), 3)
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         # With a persistent compile cache, *_cold_s on a PREWARMED run is
         # "first fit incl. cache-hit compile", not a from-scratch trace+

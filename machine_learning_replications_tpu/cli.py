@@ -28,6 +28,7 @@ every flag the reference hard-codes has a config field (SURVEY.md §5
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -92,13 +93,73 @@ def _build_mesh(args):
     return make_mesh(data=parts[0], model=parts[1])
 
 
+@contextlib.contextmanager
+def _observed(args, command: str, config_json: str | None = None):
+    """Stand up the obs layer for one CLI run (docs/OBSERVABILITY.md):
+    jax.monitoring accounting into the global registry, an active tracer
+    when ``--trace-dir`` is given (Perfetto-loadable ``trace.json`` written
+    on exit), an active journal when ``--journal`` is given (manifest
+    first, then structured events, ``run_done``/``run_error`` last), and a
+    root span named after the command so every stage nests under it."""
+    from machine_learning_replications_tpu.obs import jaxmon, journal, spans
+
+    tracer = jrn = None
+    if getattr(args, "trace_dir", None) or getattr(args, "journal", None):
+        jaxmon.install()
+    # Construct everything that can fail (journal open) BEFORE touching the
+    # process-global tracer/journal slots: a failed setup must not leave a
+    # stale global absorbing later spans in in-process callers.
+    if getattr(args, "journal", None):
+        jrn = journal.RunJournal(
+            args.journal, command=command, config_json=config_json
+        )
+    if getattr(args, "trace_dir", None):
+        tracer = spans.Tracer(process_name=f"mlr-tpu {command}")
+    if jrn is not None:
+        journal.set_journal(jrn)
+    if tracer is not None:
+        spans.set_tracer(tracer)
+    try:
+        with spans.span(command):
+            yield
+    except BaseException as exc:
+        if jrn is not None:
+            jrn.event("run_error", error=f"{type(exc).__name__}: {exc}")
+        raise
+    else:
+        if jrn is not None:
+            jrn.event(
+                "run_done",
+                jax_compiles=jaxmon.compile_count(),
+                jax_compile_seconds=round(jaxmon.compile_seconds(), 3),
+            )
+    finally:
+        if jrn is not None:
+            journal.set_journal(None)
+            jrn.close()
+            print(f"journal written to {jrn.path}", file=sys.stderr)
+        if tracer is not None:
+            spans.set_tracer(None)
+            path = tracer.write(os.path.join(args.trace_dir, "trace.json"))
+            print(
+                f"trace written to {path} (load at https://ui.perfetto.dev)",
+                file=sys.stderr,
+            )
+
+
 def cmd_train(args) -> int:
+    cfg = _config(args)
+    with _observed(args, "train", config_json=cfg.to_json()):
+        return _run_train(args, cfg)
+
+
+def _run_train(args, cfg) -> int:
     import jax.numpy as jnp
 
     from machine_learning_replications_tpu.models import pipeline
+    from machine_learning_replications_tpu.obs import spans
     from machine_learning_replications_tpu.utils import metrics
 
-    cfg = _config(args)
     mesh = _build_mesh(args)
     if mesh is not None:
         print(
@@ -108,12 +169,15 @@ def cmd_train(args) -> int:
     X_dev, y_dev = _load_cohort(args, "develop")
     X_sel, y_sel = _load_cohort(args, "select")
 
-    params, info = pipeline.fit_pipeline(
-        X_dev, y_dev, cfg, mesh=mesh, checkpoint_dir=args.resume_dir
-    )
+    with spans.span("fit_pipeline", rows=int(np.asarray(X_dev).shape[0])):
+        params, info = pipeline.fit_pipeline(
+            X_dev, y_dev, cfg, mesh=mesh, checkpoint_dir=args.resume_dir
+        )
     print(f"selected {info['n_selected']} features", file=sys.stderr)
 
-    p1 = np.asarray(pipeline.pipeline_predict_proba1(params, X_sel, mesh=mesh))
+    with spans.span("evaluate") as sp:
+        p1 = sp.block(pipeline.pipeline_predict_proba1(params, X_sel, mesh=mesh))
+    p1 = np.asarray(p1)
     yy = (p1 > 0.5).astype(np.float64)  # train_ensemble_public.py:63
     rep = metrics.classification_report(jnp.asarray(y_sel), jnp.asarray(yy))
     print(metrics.report_text(rep))
@@ -162,22 +226,31 @@ def _load_patient(path: str | None) -> np.ndarray:
 
 
 def cmd_predict(args) -> int:
+    with _observed(args, "predict"):
+        return _run_predict(args)
+
+
+def _run_predict(args) -> int:
     from machine_learning_replications_tpu.models import pipeline, stacking, tree
+    from machine_learning_replications_tpu.obs import spans
     from machine_learning_replications_tpu.persist import load_inference_params
 
     x = _load_patient(args.patient)
-    params = load_inference_params(model=args.model, pkl=args.pkl)
-    if isinstance(params, pipeline.PipelineParams):
-        # Full-pipeline checkpoints select their own lasso top-k columns —
-        # route the contract row through impute → support mask → ensemble
-        # (pipeline.pipeline_predict_proba1_contract).
-        prob = float(pipeline.pipeline_predict_proba1_contract(params, x)[0])
-    elif isinstance(params, tree.TreeEnsembleParams):
-        # `sweep --save` checkpoints: a bare GBDT fit on the contractual
-        # 17 columns (models.sweep trains on selected_indices() order).
-        prob = float(tree.predict_proba1(params, x)[0])
-    else:
-        prob = float(stacking.predict_proba1(params, x)[0])
+    with spans.span("load_params") as sp:
+        params = load_inference_params(model=args.model, pkl=args.pkl)
+        sp.note(family=type(params).__name__)
+    with spans.span("predict_proba"):
+        if isinstance(params, pipeline.PipelineParams):
+            # Full-pipeline checkpoints select their own lasso top-k columns —
+            # route the contract row through impute → support mask → ensemble
+            # (pipeline.pipeline_predict_proba1_contract).
+            prob = float(pipeline.pipeline_predict_proba1_contract(params, x)[0])
+        elif isinstance(params, tree.TreeEnsembleParams):
+            # `sweep --save` checkpoints: a bare GBDT fit on the contractual
+            # 17 columns (models.sweep trains on selected_indices() order).
+            prob = float(tree.predict_proba1(params, x)[0])
+        else:
+            prob = float(stacking.predict_proba1(params, x)[0])
 
     # Output contract: predict_hf.py:38-40
     print(f"Probability of progressive HF is: {100.0 * prob:.2f} %")
@@ -186,13 +259,27 @@ def cmd_predict(args) -> int:
 
 def cmd_serve(args) -> int:
     """Micro-batched HTTP inference serving (docs/SERVING.md)."""
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    # The serve "config" for the manifest's config_hash: the knobs that
+    # shape serving behavior, deterministically serialized.
+    serve_cfg = json.dumps({
+        "buckets": list(buckets), "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms, "max_queue": args.max_queue,
+        "request_timeout_s": args.request_timeout,
+        "warmup": not args.no_warmup,
+        "model": args.model, "pkl": args.pkl,
+    }, sort_keys=True)
+    with _observed(args, "serve", config_json=serve_cfg):
+        return _run_serve(args, buckets)
+
+
+def _run_serve(args, buckets) -> int:
     import signal
 
     from machine_learning_replications_tpu.persist import load_inference_params
     from machine_learning_replications_tpu.serve import make_server
 
     params = load_inference_params(model=args.model, pkl=args.pkl)
-    buckets = tuple(int(b) for b in args.buckets.split(","))
     handle = make_server(
         params,
         host=args.host,
@@ -313,6 +400,20 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--seed", type=int, default=2020)
         p.add_argument("--config", help="ExperimentConfig JSON path")
 
+    def add_obs_flags(p):
+        p.add_argument(
+            "--trace-dir", default=None,
+            help="write a Perfetto-loadable Chrome-trace JSON of this "
+            "run's spans to <dir>/trace.json (load at "
+            "https://ui.perfetto.dev; docs/OBSERVABILITY.md)",
+        )
+        p.add_argument(
+            "--journal", default=None,
+            help="JSONL run-journal path: first record is a run manifest "
+            "(run id, git sha, jax/platform versions, config hash), then "
+            "structured stage/checkpoint/flush events",
+        )
+
     def add_mesh_flags(p, what: str):
         p.add_argument(
             "--mesh", default=None,
@@ -339,12 +440,14 @@ def build_parser() -> argparse.ArgumentParser:
         "run re-entered with the same data/config resumes instead of "
         "restarting (the dir is fingerprinted against its inputs)",
     )
+    add_obs_flags(t)
     t.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("predict", help="single-patient inference")
     p.add_argument("--model", help="Orbax checkpoint dir from `train --save`")
     p.add_argument("--pkl", help="legacy sklearn pickle (default: the reference artifact)")
     p.add_argument("--patient", help="patient JSON file (default: predict_hf.py example)")
+    add_obs_flags(p)
     p.set_defaults(fn=cmd_predict)
 
     v = sub.add_parser(
@@ -385,6 +488,7 @@ def build_parser() -> argparse.ArgumentParser:
         "then pay the XLA compiles)",
     )
     v.add_argument("--verbose", action="store_true", help="log each request")
+    add_obs_flags(v)
     v.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("sweep", help="5-fold CV grid sweep (config 4)")

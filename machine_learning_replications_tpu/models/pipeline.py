@@ -57,24 +57,21 @@ class PipelineParams:
 
 class _NullStages:
     """Stage runner used when no checkpoint dir is given: straight through,
-    with per-stage wall-clock progress on stderr (``utils.trace.stage_say``
-    — see its docstring for the rationale and the opt-out)."""
+    with per-stage progress/span/journal telemetry via the shared
+    ``obs.journal.stage_scope`` (the one code path both this runner and the
+    checkpointed ``persist.orbax_io.StageCheckpointer`` report through —
+    see its docstring for the stderr-line contract and the opt-out)."""
 
     def run(self, name: str, compute):
-        import time
+        from machine_learning_replications_tpu.obs.journal import stage_scope
 
-        import jax
-
-        from machine_learning_replications_tpu.utils.trace import stage_say
-
-        t0 = time.time()
-        stage_say(f"stage {name!r} ...")
-        # Block on device completion before stopping the clock: jitted
+        # Block on device completion before the stage clock stops (the
+        # span exit blocks on work registered via the handle): jitted
         # stage outputs dispatch asynchronously, and unblocked timing
         # would attribute a stage's device work to the NEXT stage's first
         # data-dependent op — the opposite of what this line is for.
-        out = jax.block_until_ready(compute())
-        stage_say(f"stage {name!r} done in {time.time() - t0:.1f}s")
+        with stage_scope(name) as sp:
+            out = sp.block(compute())
         return out
 
 

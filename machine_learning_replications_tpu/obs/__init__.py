@@ -1,0 +1,41 @@
+"""obs — unified telemetry for the whole stack (docs/OBSERVABILITY.md).
+
+The training side had a flat ``PhaseTimer`` and free-text ``stage_say``
+lines; the serving side had its own private counter/gauge/histogram
+classes; neither could answer "which nested stage recompiled?" or "what
+config produced this artifact?". This package is the one observability
+layer every other layer reports into:
+
+  ``spans``     hierarchical, thread-aware spans that block on registered
+                device work and export Chrome-trace-event JSON (open the
+                file at https://ui.perfetto.dev). ``utils.trace.PhaseTimer``
+                is now a thin adapter over these.
+  ``registry``  process-global metrics registry: labeled counter / gauge /
+                histogram families rendered as Prometheus text exposition.
+                The primitive instruments moved here from
+                ``serve/metrics.py`` (which re-exports them — metric names
+                on ``/metrics`` are unchanged).
+  ``journal``   JSONL run journal: first record is a run manifest (run id,
+                git sha, jax/platform versions, config hash), then
+                structured stage / checkpoint-restore / flush events.
+                ``stage_scope`` is the single stage-timing code path shared
+                by ``models.pipeline`` and ``persist.orbax_io``.
+  ``jaxmon``    ``jax.monitoring`` listeners accounting JIT compiles,
+                compile seconds, and host↔device transfer bytes into the
+                global registry — the serve engine's one-compile-per-bucket
+                property and training recompile regressions, measurable in
+                production.
+
+Importing this package (or ``journal``/``registry``) never imports jax:
+``bench.py``'s orchestrator — which must not touch the flaky TPU plugin —
+builds its run manifest through ``obs.journal`` too.
+"""
+
+from machine_learning_replications_tpu.obs import (  # noqa: F401
+    jaxmon,
+    journal,
+    registry,
+    spans,
+)
+
+__all__ = ["jaxmon", "journal", "registry", "spans"]

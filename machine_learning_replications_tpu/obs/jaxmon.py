@@ -1,0 +1,182 @@
+"""XLA compile / transfer accounting via ``jax.monitoring`` listeners.
+
+Two properties of this stack were previously only *test* assertions or
+post-hoc guesses:
+
+  * the serving engine compiles at most once per bucket
+    (``tests/test_serve.py`` counts traces in-process);
+  * the training loop's jitted stages compile once and are reused (a
+    recompile regression shows up only as mysteriously slow walls).
+
+``jax.monitoring`` is jax's own instrumentation bus: the runtime calls
+registered listeners at every backend compile (with its duration), every
+jaxpr trace, and every persistent-compilation-cache hit/miss. ``install``
+routes those into the process-global metrics registry as:
+
+  ``jax_compiles_total``                counter — XLA backend compiles
+  ``jax_compile_seconds_total``         counter — seconds inside compiles
+  ``jax_trace_seconds_total``           counter — seconds tracing jaxprs
+  ``jax_compilation_cache_events_total{event=...}``
+                                        counter — persistent-cache traffic
+
+so a ``/metrics`` scrape (or ``REGISTRY.snapshot()``) answers "did that
+deploy start recompiling per batch?" in production, not just under pytest.
+
+Host↔device transfer bytes have no monitoring event in this jax version,
+so the accounting is at the call sites this repo owns: route uploads
+through ``device_put`` here (the serve engine's param staging does) or
+call ``record_transfer`` where bytes are known — both feed
+``jax_transfer_bytes_total{direction=...}``.
+
+``install`` is idempotent and the listeners never raise (an observability
+hook that can fail a compile is worse than no hook); jax itself is
+imported lazily so importing this module stays safe in jax-free
+orchestrator processes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from machine_learning_replications_tpu.obs.registry import (
+    REGISTRY,
+    MetricsRegistry,
+)
+
+# The duration-event keys jax 0.4.x emits (jax/_src/dispatch.py).
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_CACHE_PREFIX = "/jax/compilation_cache/"
+
+_installed = False
+_families: dict[str, Any] = {}
+_bound_registry: MetricsRegistry | None = None
+
+
+def _declare(registry: MetricsRegistry) -> dict[str, Any]:
+    return {
+        "compiles": registry.counter(
+            "jax_compiles_total",
+            "XLA backend compiles observed via jax.monitoring.",
+        ),
+        "compile_seconds": registry.counter(
+            "jax_compile_seconds_total",
+            "Seconds spent in XLA backend compilation.",
+        ),
+        "trace_seconds": registry.counter(
+            "jax_trace_seconds_total",
+            "Seconds spent tracing jaxprs (includes lowering).",
+        ),
+        "cache_events": registry.counter(
+            "jax_compilation_cache_events_total",
+            "Persistent compilation cache traffic by event.",
+            labels=("event",),
+        ),
+        "transfer_bytes": registry.counter(
+            "jax_transfer_bytes_total",
+            "Host/device transfer bytes accounted at instrumented call "
+            "sites (obs.jaxmon.device_put / record_transfer).",
+            labels=("direction",),
+        ),
+    }
+
+
+def install(registry: MetricsRegistry | None = None) -> dict[str, Any]:
+    """Register the listeners (once per process) and return the instrument
+    families. Safe to call from several wiring points — the CLI, the serve
+    stack, and tests all do.
+
+    The listeners bind to ONE registry for the process lifetime (the one
+    the first ``install`` names; default the global ``REGISTRY``): the
+    already-registered ``jax.monitoring`` callbacks write through the
+    module-level families, so silently rebinding them on a later call
+    would freeze the registry every existing ``/metrics`` page serves.
+    A later call naming a *different* registry is therefore an error."""
+    global _installed, _families, _bound_registry
+    reg = registry or REGISTRY
+    if _installed:
+        if reg is not _bound_registry:
+            raise ValueError(
+                "obs.jaxmon is already installed against a different "
+                "registry; the jax.monitoring listeners bind once per "
+                "process"
+            )
+        return _families
+    _families = _declare(reg)
+    _bound_registry = reg
+    import jax
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    jax.monitoring.register_event_listener(_on_event)
+    _installed = True
+    return _families
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    try:
+        if event == COMPILE_EVENT:
+            _families["compiles"].get().inc()
+            _families["compile_seconds"].get().inc(float(duration))
+        elif event in (TRACE_EVENT, LOWER_EVENT):
+            _families["trace_seconds"].get().inc(float(duration))
+    except Exception:  # noqa: BLE001 — never fail a compile from a hook
+        pass
+
+
+def _on_event(event: str, **kw) -> None:
+    try:
+        if event.startswith(_CACHE_PREFIX):
+            _families["cache_events"].inc(
+                event=event[len(_CACHE_PREFIX):]
+            )
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def compile_count() -> int | float:
+    """Current process-lifetime compile count (0 before ``install``)."""
+    fam = _families.get("compiles")
+    return fam.get().value if fam is not None else 0
+
+
+def compile_seconds() -> float:
+    fam = _families.get("compile_seconds")
+    return float(fam.get().value) if fam is not None else 0.0
+
+
+def record_transfer(direction: str, nbytes: int) -> None:
+    """Account ``nbytes`` of host↔device traffic (direction 'h2d'/'d2h').
+    No-op before ``install`` — call sites stay unconditional."""
+    fam = _families.get("transfer_bytes")
+    if fam is not None and nbytes:
+        fam.inc(int(nbytes), direction=direction)
+
+
+def _pytree_nbytes(x: Any) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(x):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def device_put(x: Any, *args, **kwargs) -> Any:
+    """``jax.device_put`` with h2d byte accounting — the staging wrapper
+    for call sites that upload params or cohorts."""
+    import jax
+
+    record_transfer("h2d", _pytree_nbytes(x))
+    return jax.device_put(x, *args, **kwargs)
+
+
+def device_get(x: Any) -> Any:
+    """``jax.device_get`` with d2h byte accounting."""
+    import jax
+
+    out = jax.device_get(x)
+    record_transfer("d2h", _pytree_nbytes(out))
+    return out

@@ -1,0 +1,259 @@
+"""JSONL run journal: a manifest line, then structured events.
+
+A multi-hour fit (or a long-lived serving process) answered "what produced
+this artifact?" with nothing: ``stage_say`` printed free-text lines to
+stderr with a time-of-day timestamp, and the BENCH artifacts carried
+numbers with no record of the code or config that made them. The journal
+fixes both:
+
+  * **Manifest first.** The journal's first record is a run manifest —
+    run id, ISO-8601 UTC start time, command, git sha (+dirty flag),
+    package/jax/python versions, platform, and a sha256 hash of the
+    ExperimentConfig JSON — so any journal (and any BENCH artifact, which
+    embeds the same manifest) names exactly what produced it.
+    ``run_manifest`` builds the dict without importing jax (versions come
+    from ``importlib.metadata``): ``bench.py``'s orchestrator, which must
+    never touch the TPU plugin, calls it too.
+  * **Structured events after.** One JSON object per line, ``ts`` in
+    ISO-8601 UTC (the r4 lesson behind ``stage_say``'s timestamp fix: a
+    multi-hour log with time-of-day-only local stamps is ambiguous across
+    midnight and timezones), ``kind`` plus event-specific fields. The
+    stage runners emit ``stage_start`` / ``stage_done`` /
+    ``checkpoint_restore``; the serving batcher emits ``flush``.
+
+``stage_scope`` is the deduplication point the stage runners share: the
+same stderr lines ``models.pipeline._NullStages`` and
+``persist.orbax_io.StageCheckpointer`` used to format independently, plus
+a span and journal events, in one code path.
+
+A process-global *active* journal (``set_journal`` / ``get_journal``)
+mirrors the active tracer: call sites log unconditionally through the
+module-level ``event``, which is a no-op until a journal is installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Iterator
+
+from machine_learning_replications_tpu.obs import spans
+
+
+def utc_now_iso() -> str:
+    """ISO-8601 UTC to millisecond precision, 'Z'-suffixed."""
+    t = time.time()
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + (
+        ".%03dZ" % (int(t * 1000) % 1000)
+    )
+
+
+def _git_sha(repo_dir: str | None = None) -> dict:
+    """Best-effort git provenance (sha + dirty flag); {} outside a repo or
+    without git. Never raises — a manifest must not be able to fail a run.
+
+    The repo must BE the package's own checkout: ``git rev-parse`` walks
+    upward, so a pip-installed copy whose site-packages happens to live
+    inside some unrelated repository (venv-in-project layout) would
+    otherwise stamp that project's HEAD into the manifest — silently wrong
+    provenance is worse than none."""
+    cwd = repo_dir or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=cwd, timeout=10,
+            capture_output=True, text=True,
+        )
+        if top.returncode != 0 or os.path.realpath(top.stdout.strip()) != \
+                os.path.realpath(cwd):
+            return {}
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd, timeout=10,
+            capture_output=True, text=True,
+        )
+        if sha.returncode != 0:
+            return {}
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=cwd, timeout=10,
+            capture_output=True, text=True,
+        )
+        return {
+            "git_sha": sha.stdout.strip(),
+            "git_dirty": bool(dirty.stdout.strip())
+            if dirty.returncode == 0 else None,
+        }
+    except (OSError, subprocess.SubprocessError):
+        return {}
+
+
+def _dist_version(name: str) -> str | None:
+    """Package version from installed metadata — crucially WITHOUT importing
+    the package (the bench orchestrator records jax's version while staying
+    unable to hang on jax's backend init)."""
+    try:
+        from importlib.metadata import version
+
+        return version(name)
+    except Exception:
+        return None
+
+
+def config_hash(config_json: str | bytes | None) -> str | None:
+    """sha256 of the config JSON — the manifest's binding to hyperparameters
+    (the stage-checkpoint fingerprint binds to data too; this one is cheap
+    and comparable across cohorts)."""
+    if config_json is None:
+        return None
+    if isinstance(config_json, str):
+        config_json = config_json.encode()
+    return hashlib.sha256(config_json).hexdigest()
+
+
+def run_manifest(
+    command: str | None = None,
+    config_json: str | None = None,
+    extra: dict | None = None,
+) -> dict:
+    """The run-provenance record every journal starts with and every BENCH
+    artifact embeds. jax-import-free by design (see module docstring)."""
+    import platform
+
+    man = {
+        "kind": "manifest",
+        "run_id": uuid.uuid4().hex[:12],
+        "ts": utc_now_iso(),
+        "command": command,
+        "argv": list(sys.argv),
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "hostname": platform.node(),
+        "pid": os.getpid(),
+        "versions": {
+            "machine_learning_replications_tpu":
+                _dist_version("machine-learning-replications-tpu"),
+            "jax": _dist_version("jax"),
+            "jaxlib": _dist_version("jaxlib"),
+        },
+        "config_hash": config_hash(config_json),
+        **_git_sha(),
+    }
+    if extra:
+        man.update(extra)
+    return man
+
+
+class RunJournal:
+    """Append-structured-events-to-one-file; first record is the manifest.
+
+    Writes are line-buffered under a lock and flushed per event: a
+    preempted run's journal is readable up to the last completed event
+    (the same durability posture as ``stage_say``'s flush=True)."""
+
+    def __init__(
+        self,
+        path: str | os.PathLike,
+        command: str | None = None,
+        config_json: str | None = None,
+        extra: dict | None = None,
+    ) -> None:
+        self.path = os.path.abspath(os.fspath(path))
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "w")
+        self.manifest = run_manifest(
+            command=command, config_json=config_json, extra=extra
+        )
+        self._write(self.manifest)
+
+    def _write(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":"), default=str)
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def event(self, kind: str, **fields: Any) -> None:
+        self._write({"ts": utc_now_iso(), "kind": kind, **fields})
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self) -> "RunJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- process-global active journal -----------------------------------------
+
+_active: RunJournal | None = None
+_active_lock = threading.Lock()
+
+
+def set_journal(journal: RunJournal | None) -> None:
+    """Install (or clear, with None) the process-global active journal."""
+    global _active
+    with _active_lock:
+        _active = journal
+
+
+def get_journal() -> RunJournal | None:
+    return _active
+
+
+def event(kind: str, **fields: Any) -> None:
+    """Record an event on the active journal; no-op without one."""
+    journal = _active
+    if journal is not None:
+        journal.event(kind, **fields)
+
+
+# -- the shared stage runner scope ------------------------------------------
+
+
+@contextlib.contextmanager
+def stage_scope(name: str, done_suffix: str = "") -> Iterator[spans.SpanHandle]:
+    """The ONE stage-timing code path for both pipeline stage runners
+    (``models.pipeline._NullStages`` straight-through and
+    ``persist.orbax_io.StageCheckpointer`` durable): emits the
+    grep-identical ``stage_say`` stderr lines both used to format
+    themselves, wraps the body in a span (``stage:<name>``), and journals
+    ``stage_start`` / ``stage_done`` / ``stage_error``. ``done_suffix`` is
+    the checkpointer's " (checkpointed)" tail; the yielded handle's
+    ``block`` defers device completion to scope exit, inside the timing.
+    """
+    from machine_learning_replications_tpu.utils.trace import stage_say
+
+    stage_say(f"stage {name!r} ...")
+    event("stage_start", stage=name)
+    t0 = time.time()
+    try:
+        with spans.span(f"stage:{name}") as handle:
+            yield handle
+    except BaseException as exc:
+        event(
+            "stage_error", stage=name,
+            seconds=round(time.time() - t0, 3),
+            error=f"{type(exc).__name__}: {exc}",
+        )
+        raise
+    dt = time.time() - t0
+    stage_say(f"stage {name!r} done in {dt:.1f}s{done_suffix}")
+    event(
+        "stage_done", stage=name, seconds=round(dt, 3),
+        checkpointed=bool(done_suffix),
+    )

@@ -1,0 +1,233 @@
+"""Hierarchical, thread-aware spans with Chrome-trace-event export.
+
+A span is a named wall-clock interval that (a) nests — each thread keeps
+its own open-span stack, so concurrent HTTP handler threads and the
+training main thread interleave without corrupting each other's
+hierarchy — and (b) closes *honestly* under JAX's async dispatch: the body
+registers device work via the yielded handle's ``block``, and span exit
+``block_until_ready``-s it before the clock stops, so a span's duration is
+real device work, not dispatch (the same discipline ``PhaseTimer``
+established; ``PhaseTimer`` is now a thin adapter over this module).
+
+Export is the Chrome trace-event format (``ph: "X"`` complete events with
+microsecond timestamps): write the JSON with ``Tracer.write`` and open it
+at https://ui.perfetto.dev (or ``chrome://tracing``). Parent/child
+containment is positional — a child's ``[ts, ts+dur]`` lies inside its
+parent's on the same ``tid`` — which is exactly how the viewers nest them.
+
+A process-global *active* tracer (``set_tracer`` / ``get_tracer``) lets
+call sites instrument unconditionally: the module-level ``span`` records
+into the active tracer when one is set and otherwise only performs the
+device-blocking contract (so timing semantics of enclosing timers hold
+with tracing off, at no event-recording cost).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+
+def _block_pending(pending: list) -> None:
+    """``jax.block_until_ready`` every registered pytree (jax imported
+    lazily: journal/bench-side importers of this module must stay jax-free)."""
+    if not pending:
+        return
+    import jax
+
+    for x in pending:
+        jax.block_until_ready(x)
+
+
+class SpanHandle:
+    """Yielded by ``span``: register device work to block on at exit, and
+    attach key/value annotations that land in the trace event's ``args``."""
+
+    __slots__ = ("_pending", "args")
+
+    def __init__(self) -> None:
+        self._pending: list[Any] = []
+        self.args: dict[str, Any] = {}
+
+    def block(self, x: Any) -> Any:
+        """Register ``x`` (any pytree of arrays) to be blocked on when the
+        span closes, and pass it through."""
+        self._pending.append(x)
+        return x
+
+    def note(self, **kv: Any) -> None:
+        """Attach annotations (JSON-friendly values) to the span."""
+        self.args.update(kv)
+
+
+class Tracer:
+    """Collects span events; one instance per run (thread-safe).
+
+    Timestamps are microseconds from tracer construction
+    (``time.perf_counter`` based — monotonic, sub-µs resolution), which is
+    what the trace viewers expect; the wall-clock epoch is recorded in the
+    exported ``otherData`` so events can be correlated with journal lines.
+
+    The event buffer is BOUNDED at ``max_events`` (a ring of the most
+    recent events, same bounded-over-unbounded discipline as the metrics
+    latency ring): a long-lived traced serving process emits one span per
+    flush forever, and an unbounded list would be a slow memory leak that
+    ends in a trace file Perfetto cannot load. Evictions are counted and
+    reported in the export's ``otherData.dropped_events``.
+    """
+
+    def __init__(self, process_name: str = "mlr-tpu",
+                 max_events: int = 250_000) -> None:
+        import collections
+
+        self._lock = threading.Lock()
+        self._events: collections.deque[dict] = collections.deque()
+        self._dropped = 0
+        self.max_events = int(max_events)
+        self._t0 = time.perf_counter()
+        self._epoch_unix = time.time()
+        self._pid = os.getpid()
+        self._tids: dict[int, int] = {}  # thread ident -> small stable tid
+        self._meta: list[dict] = []  # process/thread names: tiny, kept whole
+        self._tls = threading.local()
+        self.process_name = process_name
+
+    # -- internal ----------------------------------------------------------
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids) + 1
+                self._meta.append({
+                    "name": "thread_name", "ph": "M", "pid": self._pid,
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name},
+                })
+        return tid
+
+    def _stack(self) -> list[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # -- recording ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any) -> Iterator[SpanHandle]:
+        handle = SpanHandle()
+        handle.args.update(args)
+        tid = self._tid()
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        ts = self._now_us()
+        try:
+            yield handle
+        finally:
+            # The stack pop and event record must run even when the
+            # device blocking raises (XlaRuntimeError, debug-nans, OOM):
+            # a name left on the thread-local stack would corrupt the
+            # parentage of every later span on this thread.
+            try:
+                _block_pending(handle._pending)
+            finally:
+                dur = self._now_us() - ts
+                stack.pop()
+                ev_args = {
+                    k: (v if isinstance(
+                        v, (str, int, float, bool, type(None))) else str(v))
+                    for k, v in handle.args.items()
+                }
+                if parent is not None:
+                    ev_args.setdefault("parent", parent)
+                ev = {
+                    "name": name, "ph": "X", "cat": "span",
+                    "pid": self._pid, "tid": tid,
+                    "ts": round(ts, 3), "dur": round(dur, 3),
+                    "args": ev_args,
+                }
+                with self._lock:
+                    self._events.append(ev)
+                    if len(self._events) > self.max_events:
+                        self._events.popleft()
+                        self._dropped += 1
+
+    # -- export ------------------------------------------------------------
+
+    def export(self) -> dict:
+        """The Chrome trace-event JSON object (Perfetto-loadable)."""
+        with self._lock:
+            events = list(self._events)
+            meta = list(self._meta)
+            dropped = self._dropped
+        meta.insert(0, {
+            "name": "process_name", "ph": "M", "pid": self._pid,
+            "args": {"name": self.process_name},
+        })
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "epoch_unix_s": self._epoch_unix,
+                "process": self.process_name,
+                "dropped_events": dropped,
+            },
+        }
+
+    def write(self, path: str | os.PathLike) -> str:
+        """Write the trace JSON to ``path`` (parent dirs created); returns
+        the absolute path."""
+        path = os.path.abspath(os.fspath(path))
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.export(), f)
+        os.replace(tmp, path)
+        return path
+
+
+# -- process-global active tracer ------------------------------------------
+
+_active: Tracer | None = None
+_active_lock = threading.Lock()
+
+
+def set_tracer(tracer: Tracer | None) -> None:
+    """Install (or clear, with None) the process-global active tracer."""
+    global _active
+    with _active_lock:
+        _active = tracer
+
+
+def get_tracer() -> Tracer | None:
+    return _active
+
+
+@contextlib.contextmanager
+def span(name: str, **args: Any) -> Iterator[SpanHandle]:
+    """A span on the active tracer; with no tracer installed, a no-event
+    scope that still honors the ``block`` contract at exit (enclosing
+    timers keep their block-on-device semantics with tracing off)."""
+    tracer = _active
+    if tracer is not None:
+        with tracer.span(name, **args) as handle:
+            yield handle
+        return
+    handle = SpanHandle()
+    handle.args.update(args)
+    try:
+        yield handle
+    finally:
+        _block_pending(handle._pending)

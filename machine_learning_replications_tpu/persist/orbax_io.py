@@ -275,17 +275,21 @@ class StageCheckpointer:
         sidecar atomically, so a present sidecar implies a complete one;
         should a corrupt checkpoint nonetheless surface (e.g. torn tensorstore
         files from a crash mid-``save_params``), the stage falls back to
-        recomputing rather than wedging the resume."""
-        import time
+        recomputing rather than wedging the resume.
 
+        Stage timing/stderr/journal telemetry is the shared
+        ``obs.journal.stage_scope`` code path (same lines as the
+        straight-through runner, " (checkpointed)" suffixed)."""
         import jax
 
+        from machine_learning_replications_tpu.obs import journal
         from machine_learning_replications_tpu.utils.trace import stage_say
 
         if self.completed(name):
             try:
                 out = load_model(self._path(name))
                 stage_say(f"stage {name!r} restored from checkpoint")
+                journal.event("checkpoint_restore", stage=name)
                 return out
             except Exception as e:
                 import shutil
@@ -295,11 +299,16 @@ class StageCheckpointer:
                     f"stage {name!r}: checkpoint corrupt "
                     f"({type(e).__name__}) — discarded, recomputing"
                 )
-        stage_say(f"stage {name!r} ...")
-        t0 = time.time()
-        out = jax.block_until_ready(compute())
-        save_model(self._path(name), out)
-        stage_say(f"stage {name!r} done in {time.time() - t0:.1f}s (checkpointed)")
+                journal.event(
+                    "checkpoint_corrupt", stage=name,
+                    error=type(e).__name__,
+                )
+        with journal.stage_scope(name, done_suffix=" (checkpointed)"):
+            # Block explicitly (not via the span handle): save_model must
+            # only run on completed outputs, and its durable write belongs
+            # inside the stage's timing, as before.
+            out = jax.block_until_ready(compute())
+            save_model(self._path(name), out)
         if self._interrupt_after == name:
             raise SimulatedInterrupt(f"after stage {name!r}")
         return out

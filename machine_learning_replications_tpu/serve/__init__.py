@@ -12,7 +12,10 @@ no subsystem behind it. This package is that subsystem, stdlib-only:
   ``server``   HTTP front end: ``/predict`` (17-variable patient JSON),
                ``/healthz``, ``/metrics``
   ``metrics``  latency quantiles, queue depth, batch-size and
-               padding-waste histograms
+               padding-waste histograms (instrument primitives shared
+               with — and re-exported from — ``obs.registry``; /metrics
+               also appends the global registry's jax compile/transfer
+               accounting, docs/OBSERVABILITY.md)
 
 Entry point: ``python -m machine_learning_replications_tpu serve``; load
 generator: ``tools/loadgen.py``. Architecture notes: ``docs/SERVING.md``.

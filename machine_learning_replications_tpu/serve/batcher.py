@@ -29,6 +29,8 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from machine_learning_replications_tpu.obs import journal, spans
+
 
 class Overloaded(RuntimeError):
     """Admission queue full — the request was shed, not queued."""
@@ -153,15 +155,24 @@ class MicroBatcher:
             # np.stack inside the try: a mis-shaped row slipping past
             # submit must fail its batch's futures, not kill the flush
             # thread (which would wedge the batcher permanently).
-            X = np.stack([p.row for p in batch])
-            probs = np.asarray(self._engine.predict(X), np.float64)
+            with spans.span("serve:flush", rows=len(batch)):
+                X = np.stack([p.row for p in batch])
+                probs = np.asarray(self._engine.predict(X), np.float64)
         except Exception as exc:
             if self._metrics is not None:
                 self._metrics.errors_total.inc(len(batch))
+            journal.event(
+                "flush", rows=len(batch), ok=False,
+                error=f"{type(exc).__name__}: {exc}",
+            )
             for p in batch:
                 p.future.set_exception(exc)
             return
         now = time.monotonic()
+        journal.event(
+            "flush", rows=len(batch), ok=True,
+            oldest_wait_s=round(now - batch[0].t_enqueue, 6),
+        )
         if self._metrics is not None:
             self._metrics.batches_total.inc()
             self._metrics.batch_size.observe(len(batch))

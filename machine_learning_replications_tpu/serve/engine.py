@@ -36,6 +36,8 @@ from typing import Sequence
 
 import numpy as np
 
+from machine_learning_replications_tpu.obs import jaxmon, spans
+
 DEFAULT_BUCKETS = (1, 8, 64, 512)
 
 
@@ -86,8 +88,9 @@ class BucketedPredictEngine:
         # program), device_put ONCE here so the ensemble is not re-uploaded
         # host-to-device on every flushed batch. Same shapes and dtypes
         # every call, so the executable cache still keys only on the batch
-        # shape — one compile per bucket.
-        dparams = jax.device_put(params)
+        # shape — one compile per bucket. The obs wrapper accounts the
+        # upload's bytes (jax_transfer_bytes_total{direction="h2d"}).
+        dparams = jaxmon.device_put(params)
         if isinstance(params, pipeline.PipelineParams):
             from machine_learning_replications_tpu.models import knn_impute
 
@@ -201,9 +204,10 @@ class BucketedPredictEngine:
         times: dict[int, float] = {}
         for b in self.buckets:
             t0 = time.monotonic()
-            jax.block_until_ready(
-                self._impl(np.repeat(row, b, axis=0))
-            )
+            with spans.span("serve:warmup", bucket=b):
+                jax.block_until_ready(
+                    self._impl(np.repeat(row, b, axis=0))
+                )
             times[b] = time.monotonic() - t0
             if say is not None:
                 say(f"warmup bucket {b}: {times[b]:.2f}s")

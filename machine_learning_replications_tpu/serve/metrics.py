@@ -13,111 +13,28 @@ rather than streaming sketches: a serving process answering p99 questions
 about *recent* traffic wants a sliding window anyway, and the ring keeps
 the memory bound explicit (one f64 per slot) — the same
 bounded-over-unbounded discipline as the batcher's admission queue.
+
+The primitive instruments (``Counter`` / ``Gauge`` / ``Histogram``) now
+live in ``obs.registry`` — the process-global metrics layer the whole
+stack shares — and are re-exported here unchanged for backward
+compatibility; every ``serve_*`` metric name and its exposition stay
+byte-identical. The serving ``/metrics`` page additionally appends the
+global registry's exposition (jax compile/transfer accounting —
+``obs.jaxmon``); see ``serve.server``.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Sequence
 
-import numpy as np
-
-
-class Counter:
-    """Monotonic counter (thread-safe)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._value = 0
-
-    def inc(self, n: int = 1) -> None:
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> int:
-        with self._lock:
-            return self._value
-
-
-class Gauge:
-    """Last-write-wins instantaneous value (thread-safe)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._value = 0.0
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._value = float(v)
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-
-class Histogram:
-    """Cumulative-bucket histogram plus a quantile ring.
-
-    ``buckets`` are upper bounds (``le``) in ascending order; an implicit
-    +Inf bucket catches the tail. ``quantile`` interpolates over the ring
-    of the most recent ``ring_size`` observations (numpy percentile,
-    linear interpolation), so p50/p95/p99 track current traffic instead of
-    the process's whole life.
-    """
-
-    def __init__(self, buckets: Sequence[float], ring_size: int = 8192) -> None:
-        self._lock = threading.Lock()
-        self._bounds = tuple(sorted(float(b) for b in buckets))
-        self._counts = [0] * (len(self._bounds) + 1)  # +Inf tail
-        self._sum = 0.0
-        self._count = 0
-        self._ring = np.empty(ring_size, np.float64)
-        self._ring_n = 0  # total ever written; ring index = n % size
-
-    def observe(self, v: float) -> None:
-        v = float(v)
-        with self._lock:
-            i = 0
-            while i < len(self._bounds) and v > self._bounds[i]:
-                i += 1
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
-            self._ring[self._ring_n % self._ring.shape[0]] = v
-            self._ring_n += 1
-
-    def quantile(self, q: float | Sequence[float]):
-        """Quantile(s) in [0, 1] over the recent-observation ring
-        (NaN when empty)."""
-        with self._lock:
-            n = min(self._ring_n, self._ring.shape[0])
-            window = self._ring[:n].copy()
-        if n == 0:
-            return (
-                float("nan")
-                if isinstance(q, float)
-                else [float("nan")] * len(list(q))
-            )
-        out = np.percentile(window, np.asarray(q, np.float64) * 100.0)
-        return float(out) if isinstance(q, float) else [float(x) for x in out]
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            cum, acc = [], 0
-            for c in self._counts:
-                acc += c
-                cum.append(acc)
-            return {
-                "buckets": {
-                    **{str(b): cum[i] for i, b in enumerate(self._bounds)},
-                    "+Inf": cum[-1],
-                },
-                "sum": self._sum,
-                "count": self._count,
-            }
+# Re-exported: the serving layer's instruments are the shared obs
+# primitives (import sites and pickles of these classes keep working).
+from machine_learning_replications_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+)
 
 
 # Latency buckets in seconds: sub-ms through 10 s, roughly log-spaced — wide

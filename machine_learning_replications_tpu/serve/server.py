@@ -14,7 +14,12 @@ shared micro-batcher, no third-party dependencies):
   GET  /healthz   liveness/readiness: params family, bucket ladder, warm
                   flag, queue depth.
   GET  /metrics   Prometheus text exposition (``?format=json`` for the
-                  same data as JSON) — ``serve.metrics``.
+                  same data as JSON) — ``serve.metrics``, with the
+                  process-global ``obs`` registry's exposition appended
+                  (jax compile counts/seconds and transfer bytes from
+                  ``obs.jaxmon``, installed at ``make_server``), so one
+                  scrape answers both "is the server shedding?" and "did
+                  it start recompiling?".
 
 ``ServerHandle.shutdown`` is the graceful path: stop accepting, drain the
 batcher (admitted requests are never dropped), then stop the listener.
@@ -37,6 +42,8 @@ class _Server(ThreadingHTTPServer):
     # contract this layer is built around.
     request_queue_size = 128
 
+from machine_learning_replications_tpu.obs import jaxmon
+from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.serve.batcher import (
     MicroBatcher,
     Overloaded,
@@ -131,11 +138,18 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
             elif url.path == "/metrics":
                 fmt = parse_qs(url.query).get("format", ["prometheus"])[0]
                 if fmt == "json":
-                    self._json(200, metrics.snapshot())
+                    snap = metrics.snapshot()
+                    snap["runtime"] = REGISTRY.snapshot()
+                    self._json(200, snap)
                 else:
+                    # serve_* exposition first, byte-identical to the
+                    # standalone render; the global registry (jax compile
+                    # and transfer accounting) appended as its own
+                    # families.
+                    text = metrics.render_prometheus() + \
+                        REGISTRY.render_prometheus()
                     self._reply(
-                        200, metrics.render_prometheus().encode(),
-                        "text/plain; version=0.0.4",
+                        200, text.encode(), "text/plain; version=0.0.4",
                     )
             else:
                 self._json(404, {"error": f"no such path: {url.path}"})
@@ -238,6 +252,9 @@ def make_server(
     still completes before this returns (warm standby — the first served
     request never pays a compile); start serving first and call
     ``engine.warmup`` yourself for observable warm=false readiness."""
+    # Compile/transfer accounting BEFORE the engine exists, so the param
+    # upload and every warmup compile land in the /metrics counters.
+    jaxmon.install()
     engine = BucketedPredictEngine(params, buckets=buckets)
     metrics = ServingMetrics(batch_buckets=engine.buckets)
     batcher = MicroBatcher(

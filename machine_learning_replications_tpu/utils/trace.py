@@ -7,7 +7,9 @@ supplies:
   * ``PhaseTimer`` — wall-clock accounting per pipeline phase (ingest,
     impute, select, member fits, …), blocking on device completion so a
     phase's time is real work, not dispatch. The ≥10× speedup claim in
-    BASELINE.json is measured with these.
+    BASELINE.json is measured with these. Since the ``obs`` subsystem
+    landed it is a thin adapter over ``obs.spans`` — phases also appear
+    as spans in the Perfetto timeline when a tracer is active.
   * ``device_trace`` — ``jax.profiler`` capture around a region, producing
     a Perfetto/TensorBoard trace directory of on-device timelines.
   * ``nan_guard`` — opt-in ``jax_debug_nans`` scope, the functional-world
@@ -19,32 +21,25 @@ supplies:
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import time
-from typing import Any, Iterator
+from typing import Iterator
 
 import jax
 
-
-class _Phase:
-    """Handle yielded by ``PhaseTimer.phase`` — lets the body register work
-    to block on before the phase clock stops."""
-
-    def __init__(self) -> None:
-        self._pending: list[Any] = []
-
-    def block(self, x: Any) -> Any:
-        """Register ``x`` (any pytree of arrays) to be ``block_until_ready``-ed
-        when the phase closes, and pass it through."""
-        self._pending.append(x)
-        return x
+from machine_learning_replications_tpu.obs import spans
 
 
 class PhaseTimer:
     """Accumulates named phase durations; phases may repeat (times sum).
 
-    JAX dispatch is asynchronous, so a phase's exit blocks on everything the
-    body registered via the yielded handle — the recorded time is real
-    device work, not dispatch:
+    Now a thin adapter over ``obs.spans``: each phase opens a span (so a
+    run with an active tracer gets the phase in its Perfetto timeline,
+    nested under whatever span encloses it) and the span's exit performs
+    the device blocking. JAX dispatch is asynchronous, so a phase's exit
+    blocks on everything the body registered via the yielded handle — the
+    recorded time is real device work, not dispatch:
 
     >>> t = PhaseTimer()
     >>> with t.phase("fit") as ph:
@@ -57,14 +52,15 @@ class PhaseTimer:
         self.counts: dict[str, int] = {}
 
     @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[_Phase]:
-        ph = _Phase()
+    def phase(self, name: str) -> Iterator[spans.SpanHandle]:
         t0 = time.perf_counter()
         try:
-            yield ph
+            # The span blocks on registered work at ITS exit, which is
+            # inside this timing scope — identical semantics to the old
+            # standalone implementation.
+            with spans.span(name) as ph:
+                yield ph
         finally:
-            for x in ph._pending:
-                jax.block_until_ready(x)
             dt = time.perf_counter() - t0
             self.seconds[name] = self.seconds.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
@@ -84,17 +80,18 @@ class PhaseTimer:
 def stage_say(msg: str) -> None:
     """One timestamped stderr progress line, shared by both pipeline stage
     runners (checkpointed and straight-through) so their output stays
-    grep-identical. A multi-hour scaled fit with six silent stages is
-    undiagnosable from outside (r4 lesson: a 4M single-core run gave no
-    signal of which stage it was in for hours). Opt out with
-    ``MLR_TPU_PROGRESS=0`` (e.g. fits inside tight candidate loops)."""
-    import os
-    import sys
-
+    grep-identical — they now route through ``obs.journal.stage_scope``,
+    the single code path that formats these lines. A multi-hour scaled fit
+    with six silent stages is undiagnosable from outside (r4 lesson: a 4M
+    single-core run gave no signal of which stage it was in for hours).
+    The timestamp is ISO-8601 UTC: a time-of-day-only local stamp is
+    ambiguous the moment a scaled fit crosses midnight or the log is read
+    in another timezone. Opt out with ``MLR_TPU_PROGRESS=0`` (e.g. fits
+    inside tight candidate loops)."""
     if os.environ.get("MLR_TPU_PROGRESS", "1") == "0":
         return
-    print(f"[pipeline {time.strftime('%H:%M:%S')}] {msg}",
-          file=sys.stderr, flush=True)
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(f"[pipeline {stamp}] {msg}", file=sys.stderr, flush=True)
 
 
 @contextlib.contextmanager

@@ -113,6 +113,64 @@ class _Args:
     detail_out = None
 
 
+class TestManifest:
+    """Every BENCH artifact embeds a run manifest (ISSUE 2): provenance on
+    the detail payload, a compact digest on the stdout line — and the
+    orchestrator stays jax-free building it."""
+
+    def test_payload_carries_manifest(self):
+        state = bench._RunState(_Args())
+        payload = state.build_payload()
+        man = payload["manifest"]
+        assert man["kind"] == "manifest"
+        assert man["command"] == "bench"
+        assert len(man["git_sha"]) == 40
+        assert len(man["config_hash"]) == 64
+        assert man["run_id"]
+        json.dumps(payload)
+
+    def test_manifest_no_jax_in_orchestrator(self):
+        # The never-imports-jax contract must survive the manifest import
+        # (obs.journal reads versions from importlib.metadata). This test
+        # process has jax loaded via conftest, so prove it in a clean
+        # subprocess.
+        code = (
+            "import importlib.util, json, os, sys\n"
+            f"repo = {REPO!r}\n"
+            "spec = importlib.util.spec_from_file_location("
+            "'bench', os.path.join(repo, 'bench.py'))\n"
+            "mod = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(mod)\n"
+            "class A:\n"
+            "    config = None; rows = None; budget = 60; detail_out = None\n"
+            "state = mod._RunState(A())\n"
+            "assert 'jax' not in sys.modules, 'manifest pulled jax in'\n"
+            "assert state.manifest['git_sha']\n"
+            "print('CLEAN')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "CLEAN" in out.stdout
+
+    def test_summary_line_carries_compact_manifest(self, tmp_path):
+        args = _Args()
+        args.detail_out = str(tmp_path / "detail.json")
+        state = bench._RunState(args)
+        state.results["3"] = {"metric": "m", "value": 1.0, "unit": "s",
+                              "vs_baseline": 2.0, "parity_ok": True}
+        payload = state.build_payload()
+        line = state.summary_line(payload, args.detail_out)
+        assert len(line) <= bench.SUMMARY_LINE_CAP
+        parsed = json.loads(line)
+        man = parsed["manifest"]
+        assert man["run_id"] == state.manifest["run_id"]
+        assert man["git_sha"] == state.manifest["git_sha"][:12]
+        assert man["config_hash"] == state.manifest["config_hash"][:12]
+
+
 class TestFlushPayload:
     def test_partial_payload_carries_completed_configs(self):
         state = bench._RunState(_Args())

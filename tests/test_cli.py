@@ -81,17 +81,56 @@ def test_predict_patient_json(tmp_path, capsys):
 def test_train_save_plots_predict_roundtrip(tmp_path, capsys):
     ckpt = tmp_path / "model"
     plots = tmp_path / "plots"
+    trace_dir = tmp_path / "traces"
+    journal_path = tmp_path / "run.jsonl"
     rc = cli.main([
         "train",
         "--synthetic", "160",
         "--config", _fast_config(tmp_path),
         "--save", str(ckpt),
         "--plots", str(plots),
+        "--trace-dir", str(trace_dir),
+        "--journal", str(journal_path),
     ])
     assert rc == 0
     out = capsys.readouterr().out
     assert "AUC-ROC" in out and "precision" in out
     assert (plots / "roc.png").exists() and (plots / "pr.png").exists()
+
+    # --- observability artifacts (ISSUE 2 acceptance: a train run yields a
+    # Perfetto-loadable trace and a journal whose first record is a
+    # manifest with git sha + config hash) ------------------------------
+    import hashlib
+
+    with open(journal_path) as f:
+        records = [json.loads(line) for line in f]
+    man = records[0]
+    assert man["kind"] == "manifest" and man["command"] == "train"
+    assert len(man["git_sha"]) == 40
+    with open(_fast_config(tmp_path)) as f:
+        from machine_learning_replications_tpu.config import ExperimentConfig
+
+        cfg_json = ExperimentConfig.from_json(f.read()).to_json()
+    assert man["config_hash"] == hashlib.sha256(cfg_json.encode()).hexdigest()
+    kinds = [r["kind"] for r in records[1:]]
+    # every pipeline stage journaled, run closed with compile totals
+    assert kinds.count("stage_start") >= 6  # impute..meta + sub-stages
+    assert kinds[-1] == "run_done"
+    assert records[-1]["jax_compiles"] > 0
+
+    with open(trace_dir / "trace.json") as f:
+        trace_doc = json.load(f)
+    span_names = [e["name"] for e in trace_doc["traceEvents"]
+                  if e.get("ph") == "X"]
+    assert "train" in span_names and "fit_pipeline" in span_names
+    assert any(n.startswith("stage:") for n in span_names)
+    # the stage spans nest under the root command span
+    stage_ev = next(e for e in trace_doc["traceEvents"]
+                    if e.get("ph") == "X" and e["name"] == "stage:impute")
+    root_ev = next(e for e in trace_doc["traceEvents"]
+                   if e.get("ph") == "X" and e["name"] == "train")
+    assert root_ev["ts"] <= stage_ev["ts"]
+    assert stage_ev["ts"] + stage_ev["dur"] <= root_ev["ts"] + root_ev["dur"]
 
     assert cli.main(["predict", "--model", str(ckpt)]) == 0
     out = capsys.readouterr().out

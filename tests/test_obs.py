@@ -1,0 +1,503 @@
+"""The unified telemetry layer (obs/): spans, registry, journal, jaxmon,
+and the strict Prometheus exposition validator (docs/OBSERVABILITY.md).
+
+The acceptance contract (ISSUE 2): hierarchical spans exporting valid,
+containment-correct Chrome trace JSON; a registry whose exposition a
+strict Prometheus parser accepts; a journal whose first record is a
+manifest carrying git sha + config hash; jax.monitoring compile counters
+that move exactly when XLA compiles (new shape: +1, cached shape: +0);
+and the serving /metrics page — serve_* families byte-identical to the
+standalone render, global registry appended — passing the validator.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.obs import jaxmon, journal, registry, spans
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+try:
+    import validate_metrics
+finally:
+    sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def _x_events(doc):
+    return [e for e in doc["traceEvents"] if e["ph"] == "X"]
+
+
+def test_span_nesting_and_chrome_trace_export(tmp_path):
+    """Nested spans export as containment-correct complete events: the
+    child's [ts, ts+dur] lies inside the parent's on the same tid, the
+    JSON round-trips strictly, and the file is the Chrome trace shape
+    Perfetto loads (traceEvents + metadata records)."""
+    tr = spans.Tracer("test-proc")
+    with tr.span("outer", stage="fit") as outer:
+        outer.note(rows=128)
+        time.sleep(0.002)
+        with tr.span("inner"):
+            time.sleep(0.002)
+        with tr.span("inner2"):
+            pass
+
+    doc = json.loads(json.dumps(tr.export()))  # strict JSON round-trip
+    evs = {e["name"]: e for e in _x_events(doc)}
+    assert set(evs) == {"outer", "inner", "inner2"}
+    out, inn = evs["outer"], evs["inner"]
+    assert inn["tid"] == out["tid"] and inn["pid"] == out["pid"]
+    assert inn["ts"] >= out["ts"]
+    assert inn["ts"] + inn["dur"] <= out["ts"] + out["dur"]
+    assert inn["args"]["parent"] == "outer"
+    assert evs["inner2"]["args"]["parent"] == "outer"
+    assert out["args"] == {"stage": "fit", "rows": 128}
+
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+
+    path = tr.write(tmp_path / "sub" / "trace.json")
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["displayTimeUnit"] == "ms"
+    assert len(_x_events(on_disk)) == 3
+
+
+def test_spans_are_thread_aware():
+    """Concurrent threads keep independent span stacks: a thread's span
+    must not become the parent of another thread's, and each thread gets
+    its own tid track."""
+    tr = spans.Tracer()
+    barrier = threading.Barrier(2)
+
+    def worker(tag):
+        with tr.span(f"root-{tag}"):
+            barrier.wait(timeout=5)
+            with tr.span(f"leaf-{tag}"):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = {e["name"]: e for e in _x_events(tr.export())}
+    assert evs["leaf-0"]["args"]["parent"] == "root-0"
+    assert evs["leaf-1"]["args"]["parent"] == "root-1"
+    assert evs["leaf-0"]["tid"] != evs["leaf-1"]["tid"]
+
+
+def test_span_stack_survives_block_failure():
+    """A raising block_until_ready (device error mid-span) must still pop
+    the thread's span stack and record the event — a leaked stack entry
+    would mis-parent every later span on the thread."""
+    class Boom:
+        pass
+
+    def bad_block(pending):
+        if pending:
+            raise RuntimeError("device error")
+
+    tr = spans.Tracer()
+    import machine_learning_replications_tpu.obs.spans as spans_mod
+
+    orig = spans_mod._block_pending
+    spans_mod._block_pending = bad_block
+    try:
+        with pytest.raises(RuntimeError, match="device error"):
+            with tr.span("failing") as sp:
+                sp.block(Boom())
+    finally:
+        spans_mod._block_pending = orig
+    with tr.span("after"):
+        pass
+    evs = {e["name"]: e for e in _x_events(tr.export())}
+    assert set(evs) == {"failing", "after"}
+    assert "parent" not in evs["after"]["args"]  # stack was popped
+
+
+def test_tracer_event_buffer_is_bounded():
+    """A long-lived traced serving process emits spans forever; the buffer
+    is a ring of the most recent max_events, evictions counted."""
+    tr = spans.Tracer(max_events=10)
+    for i in range(25):
+        with tr.span(f"s{i}"):
+            pass
+    doc = tr.export()
+    xs = _x_events(doc)
+    assert len(xs) == 10
+    assert [e["name"] for e in xs] == [f"s{i}" for i in range(15, 25)]
+    assert doc["otherData"]["dropped_events"] == 15
+    # thread metadata survives eviction
+    assert any(e["name"] == "thread_name" for e in doc["traceEvents"])
+
+
+def test_module_span_no_tracer_still_blocks():
+    """Without an active tracer the module-level span records nothing but
+    still blocks registered device work at exit (the PhaseTimer timing
+    contract with tracing off)."""
+    import jax.numpy as jnp
+
+    assert spans.get_tracer() is None
+    with spans.span("unrecorded") as sp:
+        out = sp.block(jnp.ones(4) * 3)
+    assert float(out.sum()) == 12.0
+
+
+def test_phase_timer_is_a_span_adapter():
+    """PhaseTimer keeps its API (seconds/counts/report, block-on-exit) and
+    now also lands its phases in the active tracer's trace."""
+    from machine_learning_replications_tpu.utils.trace import PhaseTimer
+
+    tr = spans.Tracer()
+    spans.set_tracer(tr)
+    try:
+        t = PhaseTimer()
+        with t.phase("fit"):
+            time.sleep(0.001)
+        with t.phase("fit"):
+            pass
+    finally:
+        spans.set_tracer(None)
+    assert t.counts == {"fit": 2}
+    assert [e["name"] for e in _x_events(tr.export())] == ["fit", "fit"]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_families_and_exposition():
+    reg = registry.MetricsRegistry()
+    c = reg.counter("demo_bytes_total", "Bytes.", labels=("direction",))
+    c.inc(10, direction="h2d")
+    c.inc(5, direction="d2h")
+    g = reg.gauge("demo_depth", "Depth.")
+    g.get().set(3)
+    h = reg.histogram("demo_lat_seconds", "Latency.", buckets=(0.1, 1.0),
+                      labels=("route",))
+    h.observe(0.05, route="a")
+    h.observe(2.0, route="a")
+
+    text = reg.render_prometheus()
+    assert 'demo_bytes_total{direction="h2d"} 10' in text
+    assert "demo_depth 3" in text
+    assert 'demo_lat_seconds_bucket{route="a",le="+Inf"} 2' in text
+    assert validate_metrics.validate(text) == []
+
+    snap = reg.snapshot()
+    assert snap["demo_bytes_total"]["direction=h2d"] == 10
+    assert snap["demo_depth"] == 3  # unlabeled: bare value, no "" key
+    assert snap["demo_lat_seconds"]["route=a"]["count"] == 2
+    json.dumps(snap)
+
+    # idempotent re-declaration; kind/label mismatch rejected
+    assert reg.counter("demo_bytes_total", "Bytes.", labels=("direction",)) is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("demo_bytes_total", "clash")
+    with pytest.raises(ValueError, match="expected labels"):
+        c.inc(1, wrong="x")
+    with pytest.raises(ValueError):
+        reg.counter("0bad", "name")
+
+
+def test_registry_counter_rejects_negative_and_labels_escape():
+    reg = registry.MetricsRegistry()
+    c = reg.counter("neg_total", "n")
+    with pytest.raises(ValueError):
+        c.get().inc(-1)
+    g = reg.gauge("esc", "e", labels=("k",))
+    g.set(1.0, k='a"b\\c\nd')
+    text = reg.render_prometheus()
+    assert 'esc{k="a\\"b\\\\c\\nd"} 1.0' in text
+    assert validate_metrics.validate(text) == []
+
+
+def test_serve_metrics_reexports_registry_primitives():
+    """The serving layer's instrument classes ARE the obs primitives —
+    the backward-compat contract that keeps serve_* behavior identical."""
+    from machine_learning_replications_tpu.serve import metrics as sm
+
+    assert sm.Counter is registry.Counter
+    assert sm.Gauge is registry.Gauge
+    assert sm.Histogram is registry.Histogram
+    # and the serving exposition itself passes the strict validator
+    m = sm.ServingMetrics()
+    m.requests_total.inc(2)
+    m.latency.observe(0.01)
+    m.batch_size.observe(4)
+    m.padding_waste.observe(0)
+    assert validate_metrics.validate(m.render_prometheus()) == []
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_journal_manifest_first_with_provenance(tmp_path):
+    p = tmp_path / "runs" / "run.jsonl"
+    with journal.RunJournal(p, command="train",
+                            config_json='{"gbdt": 1}') as j:
+        j.event("stage_start", stage="impute")
+    recs = _read_jsonl(p)
+    man = recs[0]
+    assert man["kind"] == "manifest"
+    assert man["command"] == "train"
+    # provenance: this repo is a git checkout → sha must be present
+    assert len(man["git_sha"]) == 40
+    assert man["config_hash"] == journal.config_hash('{"gbdt": 1}')
+    assert man["versions"]["jax"]  # from importlib.metadata, no jax import
+    assert man["ts"].endswith("Z") and "T" in man["ts"]
+    assert recs[1]["kind"] == "stage_start"
+
+
+def test_stage_scope_is_the_shared_stage_path(tmp_path, capsys):
+    """One code path: grep-identical stderr lines (the pre-obs runners'
+    format, ISO-8601-UTC-stamped), a span, and journal events — including
+    the checkpointed suffix and the error path."""
+    j = journal.RunJournal(tmp_path / "j.jsonl", command="test")
+    journal.set_journal(j)
+    tr = spans.Tracer()
+    spans.set_tracer(tr)
+    try:
+        with journal.stage_scope("impute"):
+            pass
+        with journal.stage_scope("member_gbdt", done_suffix=" (checkpointed)"):
+            pass
+        with pytest.raises(RuntimeError, match="boom"):
+            with journal.stage_scope("select"):
+                raise RuntimeError("boom")
+    finally:
+        spans.set_tracer(None)
+        journal.set_journal(None)
+        j.close()
+
+    err = capsys.readouterr().err
+    assert "stage 'impute' ..." in err
+    assert "stage 'impute' done in 0.0s\n" in err
+    assert "stage 'member_gbdt' done in 0.0s (checkpointed)" in err
+    # ISO-8601 UTC stamps on every line (the stage_say timestamp fix)
+    import re
+
+    for line in err.strip().splitlines():
+        assert re.match(r"\[pipeline \d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z\] ", line)
+
+    kinds = [(r["kind"], r.get("stage")) for r in _read_jsonl(j.path)[1:]]
+    assert kinds == [
+        ("stage_start", "impute"), ("stage_done", "impute"),
+        ("stage_start", "member_gbdt"), ("stage_done", "member_gbdt"),
+        ("stage_start", "select"), ("stage_error", "select"),
+    ]
+    assert [e["name"] for e in _x_events(tr.export())] == [
+        "stage:impute", "stage:member_gbdt", "stage:select",
+    ]
+
+
+def test_module_event_noop_without_journal():
+    journal.event("flush", rows=1)  # must not raise
+
+
+def test_run_manifest_importable_without_jax():
+    """bench.py's orchestrator builds the manifest in a process that must
+    never import jax — prove the import graph stays jax-free."""
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import sys\n"
+        "from machine_learning_replications_tpu.obs.journal import run_manifest\n"
+        "m = run_manifest(command='bench', config_json='{}')\n"
+        "assert 'jax' not in sys.modules, 'obs.journal pulled in jax'\n"
+        "assert m['git_sha'] and m['config_hash']\n"
+        "print('OK')\n"
+    )
+    out = subprocess.run(
+        [_sys.executable, "-c", code],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# jaxmon: compile accounting
+# ---------------------------------------------------------------------------
+
+
+def test_jaxmon_compile_counter_moves_only_on_new_shapes():
+    """The acceptance criterion in one test: a jit call with a new shape
+    increments jax_compiles_total (and adds compile seconds); the cached
+    shape does not."""
+    import jax
+    import jax.numpy as jnp
+
+    jaxmon.install()
+    x_a = jnp.ones((3, 5))
+    x_b = jnp.ones((4, 5))  # created BEFORE counting: jnp.ones compiles too
+
+    @jax.jit
+    def f(x):
+        return (x * 2.0 + 1.0).sum()
+
+    c0, s0 = jaxmon.compile_count(), jaxmon.compile_seconds()
+    jax.block_until_ready(f(x_a))
+    c1, s1 = jaxmon.compile_count(), jaxmon.compile_seconds()
+    assert c1 == c0 + 1 and s1 > s0
+    jax.block_until_ready(f(x_a))  # cached shape: no compile
+    c2, s2 = jaxmon.compile_count(), jaxmon.compile_seconds()
+    assert (c2, s2) == (c1, s1)
+    jax.block_until_ready(f(x_b))  # new shape: one compile
+    c3 = jaxmon.compile_count()
+    assert c3 == c2 + 1
+
+    text = registry.REGISTRY.render_prometheus()
+    assert "jax_compiles_total" in text
+    assert "jax_compile_seconds_total" in text
+    assert validate_metrics.validate(text) == []
+
+
+def test_jaxmon_device_put_accounts_transfer_bytes():
+    import numpy as _np
+
+    jaxmon.install()
+    fam = registry.REGISTRY.counter(
+        "jax_transfer_bytes_total", "", labels=("direction",)
+    )
+    before = fam.labels(direction="h2d").value
+    x = _np.ones((100, 10), _np.float32)
+    jaxmon.device_put(x)
+    assert fam.labels(direction="h2d").value == before + x.nbytes
+
+
+def test_jaxmon_install_idempotent():
+    # the public jax.monitoring namespace has no listener getter in this
+    # jax version; the private module's list is the ground truth
+    from jax._src import monitoring as _mon
+
+    fams1 = jaxmon.install()
+    n = len(_mon._event_duration_secs_listeners)
+    fams2 = jaxmon.install()
+    assert len(_mon._event_duration_secs_listeners) == n
+    assert fams1.keys() == fams2.keys()
+    # the listeners bind to ONE registry per process: a later install
+    # naming a different registry must fail loudly, not silently redirect
+    # the accounting away from the page /metrics serves
+    with pytest.raises(ValueError, match="different"):
+        jaxmon.install(registry.MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# batcher journal events (the serving layer reports into the journal)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_flush_journals(tmp_path):
+    from machine_learning_replications_tpu.serve import MicroBatcher
+
+    class Stub:
+        n_features = 17
+
+        def predict(self, X):
+            return X.mean(axis=1)
+
+    j = journal.RunJournal(tmp_path / "serve.jsonl", command="serve")
+    journal.set_journal(j)
+    try:
+        b = MicroBatcher(Stub(), max_batch_size=2, max_wait_ms=1.0)
+        futs = [b.submit(np.full(17, i)) for i in range(2)]
+        assert [f.result(timeout=5.0) for f in futs] == [0.0, 1.0]
+        b.close()
+    finally:
+        journal.set_journal(None)
+        j.close()
+    flushes = [r for r in _read_jsonl(j.path) if r["kind"] == "flush"]
+    assert flushes and all(r["ok"] for r in flushes)
+    assert sum(r["rows"] for r in flushes) == 2
+
+
+# ---------------------------------------------------------------------------
+# the validator itself (it guards /metrics — it needs its own tests)
+# ---------------------------------------------------------------------------
+
+
+def test_validator_accepts_known_good_page():
+    page = (
+        "# HELP up Is it up.\n"
+        "# TYPE up gauge\n"
+        "up 1\n"
+        "# HELP req_total Requests.\n"
+        "# TYPE req_total counter\n"
+        'req_total{code="200"} 7\n'
+        'req_total{code="503"} 1\n'
+        "# HELP lat_seconds Latency.\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 1\n'
+        'lat_seconds_bucket{le="+Inf"} 2\n'
+        "lat_seconds_sum 0.3\n"
+        "lat_seconds_count 2\n"
+    )
+    assert validate_metrics.validate(page) == []
+
+
+@pytest.mark.parametrize("page, frag", [
+    # samples before their TYPE line (the strict-scraper killer)
+    ("m 1\n# TYPE m counter\n", "after its samples"),
+    # family re-opened after another family (interleaving)
+    ("# TYPE a counter\na 1\n# TYPE b counter\nb 1\na 2\n", "re-opened"),
+    # duplicate sample
+    ('# TYPE c counter\nc{k="x"} 1\nc{k="x"} 2\n', "duplicate sample"),
+    # quantile-style sample inside a histogram family (the pre-PR-1 bug)
+    ("# TYPE h histogram\n"
+     'h_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1\nh{quantile="0.5"} 2\n',
+     "not legal in histogram"),
+    # non-monotone cumulative buckets
+    ("# TYPE h2 histogram\n"
+     'h2_bucket{le="0.1"} 5\nh2_bucket{le="+Inf"} 3\nh2_sum 1\nh2_count 3\n',
+     "monotonically"),
+    # missing +Inf bucket
+    ("# TYPE h3 histogram\n"
+     'h3_bucket{le="0.1"} 1\nh3_sum 1\nh3_count 1\n', "+Inf"),
+    # _count disagrees with the +Inf bucket
+    ("# TYPE h4 histogram\n"
+     'h4_bucket{le="+Inf"} 2\nh4_sum 1\nh4_count 3\n', "_count"),
+    # negative counter
+    ("# TYPE n counter\nn -1\n", "non-negative"),
+    # malformed label set
+    ("# TYPE l counter\nl{k=unquoted} 1\n", "malformed label"),
+    # reserved label name
+    ('# TYPE r counter\nr{__name__="x"} 1\n', "reserved label"),
+    # missing trailing newline
+    ("# TYPE t counter\nt 1", "newline"),
+    # bad value token
+    ("# TYPE v counter\nv one\n", "bad value"),
+])
+def test_validator_rejects(page, frag):
+    errs = validate_metrics.validate(page)
+    assert errs, f"expected rejection for {page!r}"
+    assert any(frag in e for e in errs), (frag, errs)
+
+
+def test_validator_cli_roundtrip(tmp_path):
+    good = tmp_path / "good.prom"
+    good.write_text("# TYPE x counter\nx 1\n")
+    bad = tmp_path / "bad.prom"
+    bad.write_text("x 1\n# TYPE x counter\n")
+    assert validate_metrics.main([str(good)]) == 0
+    assert validate_metrics.main([str(bad)]) == 1

@@ -406,6 +406,48 @@ def test_http_predict_healthz_metrics(served, stacking_params):
     assert json.loads(body)["requests_total"] >= 1
 
 
+def test_http_metrics_strict_exposition_with_jax_counters(served):
+    """ISSUE 2 acceptance: the /metrics page passes the strict Prometheus
+    text-exposition validator, includes the jax compile-count /
+    compile-seconds counters from the global registry, and keeps every
+    pre-existing serve_* family byte-identical to the standalone
+    ServingMetrics render (the registry page is appended after)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import validate_metrics
+    finally:
+        _sys.path.pop(0)
+
+    handle, url = served
+    _post(url + "/predict", dict(EXAMPLE_PATIENT))  # some traffic
+    status, text = _get(url + "/metrics")
+    assert status == 200
+    assert validate_metrics.validate(text) == [], \
+        validate_metrics.validate(text)
+    # jax runtime accounting present (make_server installs obs.jaxmon
+    # before the engine, so warmup compiles are counted)
+    assert "# TYPE jax_compiles_total counter" in text
+    assert "# TYPE jax_compile_seconds_total counter" in text
+    # serve_* families byte-identical to the standalone ServingMetrics
+    # render: the page IS that render (same lines, same order) with the
+    # registry appended after. Values can move between two reads, so
+    # compare every line with its trailing value token stripped.
+    def shape(page):
+        return [
+            line if line.startswith("#") else line.rsplit(" ", 1)[0]
+            for line in page.splitlines()
+        ]
+
+    standalone = shape(handle.metrics.render_prometheus())
+    assert shape(text)[: len(standalone)] == standalone
+    # the global registry's JSON snapshot rides the json format too
+    status, body = _get(url + "/metrics?format=json")
+    snap = json.loads(body)
+    assert "jax_compiles_total" in snap["runtime"]
+
+
 def test_http_rejects_contract_violations(served):
     _, url = served
     for bad in (

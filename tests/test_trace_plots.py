@@ -34,6 +34,66 @@ def test_nan_guard_raises():
     assert not jax.config.jax_debug_nans
 
 
+def test_nan_guard_restores_on_body_raise():
+    """jax_debug_nans must be restored to its PRIOR value when the body
+    raises any exception — including when the guard was entered with the
+    flag already on (a nested guard must not clobber the outer scope)."""
+    import jax
+
+    assert not jax.config.jax_debug_nans  # test precondition
+    with pytest.raises(ValueError, match="mid-scope"):
+        with trace.nan_guard():
+            raise ValueError("mid-scope")
+    assert not jax.config.jax_debug_nans
+
+    # prior-True case: the outer scope's setting survives an inner raise
+    jax.config.update("jax_debug_nans", True)
+    try:
+        with pytest.raises(ValueError):
+            with trace.nan_guard():
+                raise ValueError("inner")
+        assert jax.config.jax_debug_nans
+    finally:
+        jax.config.update("jax_debug_nans", False)
+
+
+def test_nan_guard_disabled_is_inert():
+    import jax
+
+    with trace.nan_guard(enable=False):
+        assert not jax.config.jax_debug_nans
+        # NaN production must NOT raise inside a disabled guard
+        bad = jnp.log(jnp.zeros(2) - 1.0)
+        assert np.isnan(np.asarray(bad)).all()
+
+
+def test_stage_say_iso8601_utc_and_hoisted_imports(capsys, monkeypatch):
+    """stage_say stamps ISO-8601 UTC (multi-hour logs unambiguous across
+    midnight/timezones) and honors the MLR_TPU_PROGRESS=0 opt-out; the
+    os/sys imports are module-level now (no per-call import)."""
+    import re
+
+    trace.stage_say("hello stage")
+    err = capsys.readouterr().err
+    assert re.match(
+        r"^\[pipeline \d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z\] hello stage\n$",
+        err,
+    )
+    # no per-call re-import: os/sys are module globals now, and the
+    # function body contains no import statement
+    import dis
+
+    assert "os" in vars(trace) and "sys" in vars(trace)
+    assert not any(
+        ins.opname == "IMPORT_NAME"
+        for ins in dis.get_instructions(trace.stage_say)
+    )
+
+    monkeypatch.setenv("MLR_TPU_PROGRESS", "0")
+    trace.stage_say("suppressed")
+    assert capsys.readouterr().err == ""
+
+
 def test_device_trace_writes(tmp_path):
     with trace.device_trace(str(tmp_path)):
         jnp.ones(8).sum().block_until_ready()

@@ -1,0 +1,346 @@
+#!/usr/bin/env python
+"""Strict Prometheus text-exposition (version 0.0.4) validator.
+
+The pre-PR-1 serving layer shipped an exposition a lenient eyeball passed
+and a strict Prometheus scraper rejected wholesale (summary-style quantile
+samples inside a histogram family — metadata after samples). This tool is
+the regression gate: it parses an exposition page the way a strict scraper
+does and fails loudly on anything malformed, so `/metrics` format bugs die
+in CI instead of in a monitoring stack that silently drops the whole page.
+
+Checks (text format 0.0.4, plus the grouping rule scrapers enforce):
+
+  * line syntax — `# HELP`/`# TYPE` metadata, comments, samples of the
+    form `name{label="value",...} value [timestamp]`;
+  * name legality — metric `[a-zA-Z_:][a-zA-Z0-9_:]*`, label
+    `[a-zA-Z_][a-zA-Z0-9_]*`, no `__`-reserved labels;
+  * metadata discipline — at most one HELP and one TYPE per family, TYPE
+    before any of the family's samples, families not interleaved or
+    re-opened;
+  * sample-name/type agreement — histogram families expose only
+    `_bucket`/`_sum`/`_count` (+`le` on buckets), counters and gauges only
+    their bare name; unknown suffixed samples start a new (untyped)
+    family;
+  * value legality — floats, `NaN`, `+Inf`/`-Inf`; counters and histogram
+    counts must not be NaN or negative;
+  * histogram coherence — a `+Inf` bucket exists, bucket counts are
+    monotonically non-decreasing in `le` order, `_count` equals the
+    `+Inf` bucket;
+  * no duplicate sample (same name + label set) anywhere on the page;
+  * the page ends with a newline (the 0.0.4 framing requirement).
+
+Usage:
+    python tools/validate_metrics.py [file ...]      # or stdin
+    curl -s localhost:8000/metrics | python tools/validate_metrics.py
+
+Exit 0 when every input page is valid; 1 otherwise, one error per line on
+stderr. Importable: ``validate(text) -> list[str]`` returns the errors.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+
+_METRIC_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+# name{labels} value [timestamp] — labels parsed separately.
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>\S+)"
+    r"(?:\s+(?P<ts>-?\d+))?\s*$"
+)
+_LABEL_PAIR_RE = re.compile(
+    r'\s*(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*'
+    r'"(?P<value>(?:[^"\\]|\\.)*)"\s*(?P<sep>,|$)'
+)
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_SUMMARY_SUFFIXES = ("_sum", "_count")
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _parse_value(tok: str) -> float | None:
+    if tok in ("+Inf", "Inf"):
+        return math.inf
+    if tok == "-Inf":
+        return -math.inf
+    if tok == "NaN":
+        return math.nan
+    try:
+        return float(tok)
+    except ValueError:
+        return None
+
+
+def _escapes_ok(s: str) -> bool:
+    """Only \\\\, \\" and \\n are legal escapes in a label value."""
+    i = 0
+    while i < len(s):
+        if s[i] == "\\":
+            if i + 1 >= len(s) or s[i + 1] not in '\\"n':
+                return False
+            i += 2
+        else:
+            i += 1
+    return True
+
+
+def _parse_labels(raw: str, where: str, errors: list[str]) -> dict | None:
+    """The {..} body → dict; None on syntax error. Strict: only
+    `name="value"` pairs, comma separated, a trailing comma allowed."""
+    labels: dict[str, str] = {}
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_PAIR_RE.match(raw, pos)
+        if not m:
+            errors.append(f"{where}: malformed label set {{{raw}}}")
+            return None
+        name = m.group("name")
+        if name.startswith("__"):
+            errors.append(f"{where}: reserved label name {name!r}")
+            return None
+        if name in labels:
+            errors.append(f"{where}: duplicate label {name!r}")
+            return None
+        # Validate escapes: only \\ \" \n are defined for label values
+        # (scanned pairwise — a regex can't pair consecutive backslashes).
+        if not _escapes_ok(m.group("value")):
+            errors.append(
+                f"{where}: invalid escape in label value {m.group('value')!r}"
+            )
+            return None
+        labels[name] = m.group("value")
+        pos = m.end()
+        if m.group("sep") == "" and pos < len(raw):
+            errors.append(f"{where}: trailing garbage in label set")
+            return None
+    return labels
+
+
+def _base_family(name: str, typed: dict[str, str]) -> str:
+    """The family a sample line belongs to, honoring declared types: a
+    `x_bucket` sample belongs to histogram family `x` only when `x` is
+    declared histogram (summary: `_sum`/`_count` (+quantile on bare name));
+    otherwise the sample name IS the family."""
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if typed.get(base) == "histogram" and suffix in _HIST_SUFFIXES:
+                return base
+            if typed.get(base) == "summary" and suffix in _SUMMARY_SUFFIXES:
+                return base
+    return name
+
+
+class _Fam:
+    __slots__ = ("help", "type", "samples_seen", "closed", "buckets")
+
+    def __init__(self) -> None:
+        self.help: str | None = None
+        self.type: str | None = None
+        self.samples_seen = False
+        self.closed = False
+        self.buckets: dict[tuple, list[tuple[str, float]]] = {}
+
+
+def validate(text: str) -> list[str]:
+    """Validate one exposition page; returns a list of error strings
+    (empty = valid)."""
+    errors: list[str] = []
+    if text and not text.endswith("\n"):
+        errors.append("page must end with a newline (text format 0.0.4)")
+    families: dict[str, _Fam] = {}
+    typed: dict[str, str] = {}
+    current: str | None = None
+    seen_samples: set[tuple] = set()
+
+    def fam(name: str) -> _Fam:
+        f = families.get(name)
+        if f is None:
+            f = families[name] = _Fam()
+        return f
+
+    def switch_to(name: str, where: str) -> _Fam:
+        nonlocal current
+        f = fam(name)
+        if current is not None and current != name:
+            families[current].closed = True
+        if f.closed:
+            errors.append(
+                f"{where}: family {name!r} re-opened — all lines of a "
+                "family must form one group"
+            )
+        current = name
+        return f
+
+    for i, line in enumerate(text.splitlines()):
+        where = f"line {i + 1}"
+        if not line.strip():
+            errors.append(f"{where}: blank line (0.0.4 allows none)")
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] in ("HELP", "TYPE"):
+                if len(parts) < 3 or not _METRIC_RE.match(parts[2]):
+                    errors.append(f"{where}: malformed {parts[1]} line")
+                    continue
+                name = parts[2]
+                f = switch_to(name, where)
+                if parts[1] == "HELP":
+                    if f.help is not None:
+                        errors.append(f"{where}: second HELP for {name!r}")
+                    if f.samples_seen:
+                        errors.append(
+                            f"{where}: HELP for {name!r} after its samples"
+                        )
+                    f.help = parts[3] if len(parts) > 3 else ""
+                else:
+                    kind = parts[3].strip() if len(parts) > 3 else ""
+                    if kind not in _TYPES:
+                        errors.append(
+                            f"{where}: unknown TYPE {kind!r} for {name!r}"
+                        )
+                        continue
+                    if f.type is not None:
+                        errors.append(f"{where}: second TYPE for {name!r}")
+                    if f.samples_seen:
+                        errors.append(
+                            f"{where}: TYPE for {name!r} after its samples"
+                        )
+                    f.type = kind
+                    typed[name] = kind
+            # else: a plain comment — legal anywhere
+            continue
+
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"{where}: unparseable sample line {line!r}")
+            continue
+        name = m.group("name")
+        labels = _parse_labels(m.group("labels") or "", where, errors)
+        if labels is None:
+            continue
+        value = _parse_value(m.group("value"))
+        if value is None:
+            errors.append(f"{where}: bad value {m.group('value')!r}")
+            continue
+
+        base = _base_family(name, typed)
+        f = switch_to(base, where)
+        f.samples_seen = True
+        kind = f.type or "untyped"
+
+        # sample-name/type agreement
+        if kind == "histogram":
+            if name == base + "_bucket":
+                if "le" not in labels:
+                    errors.append(f"{where}: histogram bucket without le")
+                key = tuple(sorted(
+                    (k, v) for k, v in labels.items() if k != "le"
+                ))
+                f.buckets.setdefault(key, []).append(
+                    (labels.get("le", ""), value)
+                )
+            elif name not in (base + "_sum", base + "_count"):
+                errors.append(
+                    f"{where}: sample {name!r} not legal in histogram "
+                    f"family {base!r}"
+                )
+        elif kind in ("counter", "gauge") and name != base:
+            errors.append(
+                f"{where}: sample {name!r} not legal in {kind} family "
+                f"{base!r}"
+            )
+        if kind == "counter" or (
+            kind == "histogram" and name != base + "_sum"
+        ):
+            if value != value or value < 0:
+                errors.append(
+                    f"{where}: {kind} sample {name!r} must be a "
+                    f"non-negative number, got {m.group('value')}"
+                )
+
+        sig = (name, tuple(sorted(labels.items())))
+        if sig in seen_samples:
+            errors.append(
+                f"{where}: duplicate sample {name!r} with labels {labels}"
+            )
+        seen_samples.add(sig)
+
+        if kind == "histogram" and name == base + "_count":
+            key = tuple(sorted(labels.items()))
+            f.buckets.setdefault(("__count__", key), []).append(("", value))
+
+    # histogram coherence, per family and label subset
+    for name, f in families.items():
+        if f.type != "histogram":
+            continue
+        counts = {
+            key[1]: rows[0][1]
+            for key, rows in f.buckets.items()
+            if isinstance(key, tuple) and key and key[0] == "__count__"
+        }
+        series = {
+            k: v for k, v in f.buckets.items()
+            if not (isinstance(k, tuple) and k and k[0] == "__count__")
+        }
+        if not series and f.samples_seen:
+            errors.append(f"family {name!r}: histogram with no buckets")
+        for key, rows in series.items():
+            les = [le for le, _ in rows]
+            if "+Inf" not in les:
+                errors.append(
+                    f"family {name!r}{dict(key) or ''}: no +Inf bucket"
+                )
+            # monotone non-decreasing cumulative counts in le order
+            def le_val(le: str) -> float:
+                v = _parse_value(le)
+                return math.inf if v is None else v
+
+            ordered = sorted(rows, key=lambda r: le_val(r[0]))
+            vals = [v for _, v in ordered]
+            if any(b < a for a, b in zip(vals, vals[1:])):
+                errors.append(
+                    f"family {name!r}{dict(key) or ''}: bucket counts "
+                    "not monotonically non-decreasing"
+                )
+            if ordered and counts:
+                cnt = counts.get(key)
+                if cnt is not None and ordered[-1][0] == "+Inf" \
+                        and ordered[-1][1] != cnt:
+                    errors.append(
+                        f"family {name!r}{dict(key) or ''}: _count "
+                        f"({cnt}) != +Inf bucket ({ordered[-1][1]})"
+                    )
+    return errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    pages: list[tuple[str, str]] = []
+    if argv:
+        for path in argv:
+            with open(path) as fh:
+                pages.append((path, fh.read()))
+    else:
+        pages.append(("<stdin>", sys.stdin.read()))
+    rc = 0
+    for src, text in pages:
+        errs = validate(text)
+        if errs:
+            rc = 1
+            for e in errs:
+                print(f"{src}: {e}", file=sys.stderr)
+        else:
+            n = sum(
+                1 for line in text.splitlines()
+                if line and not line.startswith("#")
+            )
+            print(f"{src}: OK ({n} samples)", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
