@@ -268,6 +268,13 @@ def cmd_serve(args) -> int:
         "request_timeout_s": args.request_timeout,
         "warmup": not args.no_warmup,
         "model": args.model, "pkl": args.pkl,
+        "slo_latency_ms": args.slo_latency_ms,
+        "slo_latency_target": args.slo_latency_target,
+        "slo_availability_target": args.slo_availability_target,
+        "no_slo": args.no_slo,
+        "trace_capacity": args.trace_capacity,
+        "tail_quantile": args.tail_quantile,
+        "profile_dir": args.profile_dir,
     }, sort_keys=True)
     with _observed(args, "serve", config_json=serve_cfg):
         return _run_serve(args, buckets)
@@ -276,6 +283,7 @@ def cmd_serve(args) -> int:
 def _run_serve(args, buckets) -> int:
     import signal
 
+    from machine_learning_replications_tpu.obs import slo
     from machine_learning_replications_tpu.persist import load_inference_params
     from machine_learning_replications_tpu.serve import make_server
 
@@ -292,6 +300,16 @@ def _run_serve(args, buckets) -> int:
         request_timeout_s=args.request_timeout,
         quiet=not args.verbose,
         say=lambda m: print(m, file=sys.stderr),
+        slos=(
+            [] if args.no_slo else slo.default_slos(
+                latency_ms=args.slo_latency_ms,
+                latency_target=args.slo_latency_target,
+                availability_target=args.slo_availability_target,
+            )
+        ),
+        trace_capacity=args.trace_capacity,
+        tail_quantile=args.tail_quantile,
+        profile_dir=args.profile_dir,
     )
     host, port = handle.address
     print(
@@ -486,6 +504,41 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-warmup", action="store_true",
         help="skip the startup compile of every bucket (first requests "
         "then pay the XLA compiles)",
+    )
+    v.add_argument(
+        "--slo-latency-ms", type=float, default=250.0,
+        help="latency SLO threshold: the target fraction of requests must "
+        "answer within this many milliseconds (burn gauges on /metrics; "
+        "docs/OBSERVABILITY.md)",
+    )
+    v.add_argument(
+        "--slo-latency-target", type=float, default=0.99,
+        help="latency SLO target fraction (0, 1)",
+    )
+    v.add_argument(
+        "--slo-availability-target", type=float, default=0.999,
+        help="availability SLO target fraction: admitted requests answered "
+        "without shed/timeout/error",
+    )
+    v.add_argument(
+        "--no-slo", action="store_true",
+        help="disable SLO tracking (no slo_* families on /metrics)",
+    )
+    v.add_argument(
+        "--trace-capacity", type=int, default=256,
+        help="flight-recorder bound: max tail-sampled request traces held "
+        "for /debug/requests",
+    )
+    v.add_argument(
+        "--tail-quantile", type=float, default=0.99,
+        help="tail-sampling threshold: an ok request is kept only when its "
+        "latency reaches this quantile of recent ok traffic (failures are "
+        "always kept)",
+    )
+    v.add_argument(
+        "--profile-dir", default=None,
+        help="directory for /debug/profile captures (default: a "
+        "per-process dir under the system temp dir)",
     )
     v.add_argument("--verbose", action="store_true", help="log each request")
     add_obs_flags(v)

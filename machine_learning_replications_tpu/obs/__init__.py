@@ -25,6 +25,16 @@ layer every other layer reports into:
                 global registry — the serve engine's one-compile-per-bucket
                 property and training recompile regressions, measurable in
                 production.
+  ``reqtrace``  request-scoped tracing: per-request phase breakdown
+                (parse / queue wait / batch assembly / device compute /
+                respond) threaded through the serving path, and a bounded
+                flight recorder with tail-based sampling (keep failures
+                and the p99 tail, drop the fast majority). Sampled traces
+                merge into the active Chrome-trace export.
+  ``slo``       declarative latency/availability objectives with
+                error-budget burn gauges exported through the registry.
+  ``profiler``  on-demand ``jax.profiler`` capture with a single-flight
+                guard (the serving ``/debug/profile`` endpoint).
 
 Importing this package (or ``journal``/``registry``) never imports jax:
 ``bench.py``'s orchestrator — which must not touch the flaky TPU plugin —
@@ -34,8 +44,13 @@ builds its run manifest through ``obs.journal`` too.
 from machine_learning_replications_tpu.obs import (  # noqa: F401
     jaxmon,
     journal,
+    profiler,
     registry,
+    reqtrace,
+    slo,
     spans,
 )
 
-__all__ = ["jaxmon", "journal", "registry", "spans"]
+__all__ = [
+    "jaxmon", "journal", "profiler", "registry", "reqtrace", "slo", "spans",
+]

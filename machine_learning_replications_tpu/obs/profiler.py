@@ -1,0 +1,139 @@
+"""On-demand ``jax.profiler`` capture with a single-flight guard.
+
+The span timeline (``obs.spans``) is host-side orchestration; when a tail
+investigation needs the *device* story — which XLA ops, what overlap,
+where the compile went — the tool is jax's own profiler, which writes a
+TensorBoard/Perfetto-loadable capture (``plugins/profile/<ts>/*.xplane.pb``
+plus a ``*.trace.json.gz``). Profiling a live serving process must be
+**on demand and exclusive**: the XLA profiler is process-global state
+(``start_trace`` while a trace is active raises deep inside TSL), and two
+operators hitting ``/debug/profile`` at once must not corrupt each
+other's capture. ``capture`` is therefore single-flight — one capture at
+a time, concurrent callers get ``ProfilerBusy`` immediately (the HTTP
+layer maps it to 409) instead of queueing behind a multi-second capture.
+
+Captures are counted in the global registry (``profile_captures_total``)
+and journaled (``profile_capture`` event) so a profile artifact found on
+disk can be traced back to who asked for it and when. jax is imported
+lazily — importing this module stays safe in jax-free orchestrators.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+from machine_learning_replications_tpu.obs import journal
+from machine_learning_replications_tpu.obs.registry import REGISTRY
+
+#: Upper bound on one capture (seconds): /debug/profile is a blocking
+#: endpoint and the profiler pauses nothing, but an unbounded capture
+#: would pin the single-flight slot (and grow the artifact) forever.
+MAX_SECONDS = 60.0
+
+_lock = threading.Lock()
+_seq = 0  # capture ordinal; mutated only under _lock (single-flight)
+
+# Declared at import (the registry is jax-free), so the family is on
+# /metrics from the first scrape — an absent series and a zero series
+# read very differently to a dashboard.
+_captures = REGISTRY.counter(
+    "profile_captures_total",
+    "On-demand jax.profiler captures served, by outcome.",
+    labels=("outcome",),
+)
+_captures.labels(outcome="ok")
+_captures.labels(outcome="error")
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight — the request was rejected, not
+    queued (single-flight contract)."""
+
+
+def is_busy() -> bool:
+    """Whether a capture currently holds the single-flight slot (advisory
+    — the authoritative answer is ``capture`` raising ``ProfilerBusy``)."""
+    if _lock.acquire(blocking=False):
+        _lock.release()
+        return False
+    return True
+
+
+def _artifact_files(root: str) -> list[dict]:
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            path = os.path.join(dirpath, fn)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            out.append({"path": path, "bytes": size})
+    out.sort(key=lambda f: f["path"])
+    return out
+
+
+def capture(seconds: float, out_dir: str) -> dict[str, Any]:
+    """Run one profiler capture of ``seconds`` wall time into ``out_dir``
+    and return the artifact description (directory, files, total bytes).
+
+    Raises ``ProfilerBusy`` when another capture is in flight and
+    ``ValueError`` for an out-of-range duration. The capture directory is
+    timestamped under ``out_dir`` so repeated captures never clobber each
+    other."""
+    seconds = float(seconds)
+    if not 0.0 < seconds <= MAX_SECONDS:
+        raise ValueError(
+            f"capture seconds must be in (0, {MAX_SECONDS:g}], got {seconds:g}"
+        )
+    if not _lock.acquire(blocking=False):
+        raise ProfilerBusy("a profiler capture is already in flight")
+    try:
+        import jax
+
+        global _seq
+        _seq += 1
+        # Timestamp for the human, ordinal for uniqueness: two
+        # sub-second captures land in the same wall-clock second, and a
+        # reused directory would list the previous capture's files as
+        # this one's artifact.
+        target = os.path.join(
+            os.path.abspath(out_dir),
+            time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            + f"-{_seq:04d}",
+        )
+        os.makedirs(target, exist_ok=True)
+        t0 = time.perf_counter()
+        try:
+            jax.profiler.start_trace(target)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as exc:
+            _captures.inc(outcome="error")
+            journal.event(
+                "profile_capture", ok=False, seconds=seconds,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            raise
+        wall = time.perf_counter() - t0
+        files = _artifact_files(target)
+        artifact = {
+            "profile_dir": target,
+            "requested_seconds": seconds,
+            "wall_seconds": round(wall, 3),
+            "files": files,
+            "total_bytes": sum(f["bytes"] for f in files),
+        }
+        _captures.inc(outcome="ok")
+        journal.event(
+            "profile_capture", ok=True, seconds=seconds,
+            profile_dir=target, total_bytes=artifact["total_bytes"],
+        )
+        return artifact
+    finally:
+        _lock.release()

@@ -29,7 +29,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from machine_learning_replications_tpu.obs import journal, spans
+from machine_learning_replications_tpu.obs import jaxmon, journal, spans
 
 
 class Overloaded(RuntimeError):
@@ -37,12 +37,16 @@ class Overloaded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("row", "future", "t_enqueue")
+    __slots__ = ("row", "future", "t_enqueue", "t_enqueue_perf", "trace")
 
-    def __init__(self, row: np.ndarray) -> None:
+    def __init__(self, row: np.ndarray, trace=None) -> None:
         self.row = row
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
+        # perf_counter twin of t_enqueue: request traces stamp every phase
+        # on one clock (obs.reqtrace uses perf_counter throughout).
+        self.t_enqueue_perf = time.perf_counter()
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -71,6 +75,7 @@ class MicroBatcher:
         self._metrics = metrics
         self._cv = threading.Condition()
         self._q: deque[_Pending] = deque()
+        self._flush_seq = 0  # flush-thread-only; correlates traces↔flushes
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name="micro-batcher", daemon=True
@@ -79,10 +84,16 @@ class MicroBatcher:
 
     # -- producer side -----------------------------------------------------
 
-    def submit(self, row: np.ndarray) -> Future:
+    def submit(self, row: np.ndarray, trace=None) -> Future:
         """Enqueue one contract-order feature row; resolves to its
         probability (float). Raises ``Overloaded`` when the admission
-        queue is full and ``RuntimeError`` after ``close``."""
+        queue is full and ``RuntimeError`` after ``close``.
+
+        ``trace`` is an optional ``obs.reqtrace.RequestTrace``: the flush
+        thread stamps its queue-wait / batch-assembly / device-compute
+        phases and flush annotations (sequence, bucket, cold-compile) —
+        the batcher never *finishes* a trace; request lifecycle stays
+        with the caller."""
         row = np.asarray(row, np.float64).ravel()
         want = getattr(self._engine, "n_features", None)
         if want is not None and row.shape[0] != want:
@@ -101,7 +112,7 @@ class MicroBatcher:
                 raise Overloaded(
                     f"admission queue full ({self._max_queue} waiting)"
                 )
-            p = _Pending(row)
+            p = _Pending(row, trace=trace)
             self._q.append(p)
             if self._metrics is not None:
                 self._metrics.requests_total.inc()
@@ -143,43 +154,116 @@ class MicroBatcher:
                     self._metrics.queue_depth.set(len(self._q))
             self._flush(batch)
 
+    def _note_flush_phases(
+        self, batch: list[_Pending], t_claim: float, t_c0: float,
+        t_c1: float, annotations: dict,
+    ) -> None:
+        """Stamp each traced batch member's flush-side phases: queue wait
+        (enqueue → claim), batch assembly (claim → engine call, including
+        the cancel sweep and np.stack), device compute (the engine call,
+        which blocks through np.asarray). ``flush_index`` is the member's
+        batch position — the trace-merge slice allocator keys on it."""
+        for i, p in enumerate(batch):
+            if p.trace is None:
+                continue
+            # Queue wait starts where the caller's parse phase ended (so
+            # the phases partition the request with no gap — submit's
+            # lock wait is queueing too), falling back to the enqueue
+            # stamp for direct batcher callers with bare traces.
+            q0 = p.trace.phase_end("parse", p.t_enqueue_perf)
+            p.trace.add_phase("queue_wait", q0, t_claim)
+            p.trace.add_phase("batch_assembly", t_claim, t_c0)
+            p.trace.add_phase("device_compute", t_c0, t_c1)
+            p.trace.note(flush_index=i, **annotations)
+
     def _flush(self, batch: list[_Pending]) -> None:
         # Claim each entry (queued → running). A False return means the
         # server cancelled it on client-deadline expiry — drop it here so
         # the engine never computes answers nobody will read. A claimed
         # future can no longer be cancelled, so set_result below is safe.
+        t_claim = time.perf_counter()
+        t_claim_mono = time.monotonic()
         batch = [p for p in batch if p.future.set_running_or_notify_cancel()]
         if not batch:
             return
+        self._flush_seq += 1  # flush thread only — no lock needed
+        flush_seq = self._flush_seq
+        tracer = spans.get_tracer()
+        bucket_for = getattr(self._engine, "bucket_for", None)
+        bucket = bucket_for(len(batch)) if bucket_for is not None else None
+        # Cold-compile attribution: a flush that grows the engine's
+        # compile count (or, failing that instrument, the process
+        # compile counter) paid an XLA compile — THE canonical
+        # tail-latency outlier, worth naming on every trace it delayed.
+        engine_compiles = getattr(self._engine, "compile_count", None)
+        count_compiles = (
+            engine_compiles if engine_compiles is not None
+            else jaxmon.compile_count
+        )
+        compiles0 = count_compiles()
+        if self._metrics is not None:
+            for p in batch:
+                self._metrics.queue_wait.observe(
+                    t_claim_mono - p.t_enqueue
+                )
+        t_c0 = t_c1 = None
         try:
             # np.stack inside the try: a mis-shaped row slipping past
             # submit must fail its batch's futures, not kill the flush
             # thread (which would wedge the batcher permanently).
-            with spans.span("serve:flush", rows=len(batch)):
+            with spans.span("serve:flush", rows=len(batch)) as sp:
                 X = np.stack([p.row for p in batch])
+                t_c0 = time.perf_counter()
                 probs = np.asarray(self._engine.predict(X), np.float64)
+                t_c1 = time.perf_counter()
+                cold = count_compiles() > compiles0
+                sp.note(flush_seq=flush_seq, bucket=bucket,
+                        cold_compile=cold)
         except Exception as exc:
             if self._metrics is not None:
                 self._metrics.errors_total.inc(len(batch))
             journal.event(
-                "flush", rows=len(batch), ok=False,
+                "flush", seq=flush_seq, rows=len(batch), ok=False,
                 error=f"{type(exc).__name__}: {exc}",
+            )
+            # Partial phase record: queue wait and assembly happened, and
+            # the compute interval ends where the engine raised — a
+            # sampled failure trace still says where the time went.
+            t_err = time.perf_counter()
+            self._note_flush_phases(
+                batch, t_claim, t_c0 if t_c0 is not None else t_err,
+                t_c1 if t_c1 is not None else t_err,
+                {
+                    "flush_seq": flush_seq, "batch_rows": len(batch),
+                    "bucket": bucket,
+                    "flush_tid": (
+                        tracer.current_tid() if tracer is not None else None
+                    ),
+                },
             )
             for p in batch:
                 p.future.set_exception(exc)
             return
         now = time.monotonic()
         journal.event(
-            "flush", rows=len(batch), ok=True,
+            "flush", seq=flush_seq, rows=len(batch), ok=True,
+            bucket=bucket, cold_compile=cold,
             oldest_wait_s=round(now - batch[0].t_enqueue, 6),
         )
+        self._note_flush_phases(batch, t_claim, t_c0, t_c1, {
+            "flush_seq": flush_seq, "batch_rows": len(batch),
+            "bucket": bucket, "cold_compile": cold,
+            "padded_rows": (
+                max(bucket - len(batch), 0) if bucket is not None else 0
+            ),
+            "flush_tid": tracer.current_tid() if tracer is not None else None,
+        })
         if self._metrics is not None:
             self._metrics.batches_total.inc()
             self._metrics.batch_size.observe(len(batch))
-            bucket_for = getattr(self._engine, "bucket_for", None)
-            if bucket_for is not None:
+            if bucket is not None:
                 self._metrics.padding_waste.observe(
-                    max(bucket_for(len(batch)) - len(batch), 0)
+                    max(bucket - len(batch), 0)
                 )
             for p in batch:
                 self._metrics.latency.observe(now - p.t_enqueue)

@@ -160,6 +160,12 @@ class BucketedPredictEngine:
         # so each increment corresponds to exactly one XLA compile.
         self.trace_counts[rows] = self.trace_counts.get(rows, 0) + 1
 
+    def compile_count(self) -> int:
+        """Total engine compiles so far. The batcher samples this around
+        each flush: a flush that moves it paid a cold bucket compile —
+        the attribution request traces carry as ``cold_compile``."""
+        return sum(self.trace_counts.values())
+
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket holding ``n`` rows (the largest bucket
         for anything bigger — ``predict`` chunks such batches)."""

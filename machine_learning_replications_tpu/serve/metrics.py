@@ -44,6 +44,17 @@ LATENCY_BUCKETS_S = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# Queue-wait buckets: tuned around the flush interval (--max-wait-ms,
+# default 5 ms). A healthy server's waits cluster at or below that knob
+# (sub-bucket resolution on both sides of it); the tail buckets exist to
+# make queueing collapse visible — waits 10–1000× the flush interval are
+# the overload signature tail sampling attributes per request, and this
+# histogram shows in aggregate on every scrape.
+QUEUE_WAIT_BUCKETS_S = (
+    0.0005, 0.001, 0.0025, 0.005, 0.0075, 0.01, 0.025, 0.05,
+    0.1, 0.25, 1.0, 5.0,
+)
+
 
 class ServingMetrics:
     """The fixed instrument set the serving layer exports.
@@ -70,6 +81,7 @@ class ServingMetrics:
         self.batches_total = Counter()
         self.queue_depth = Gauge()
         self.latency = Histogram(LATENCY_BUCKETS_S)
+        self.queue_wait = Histogram(QUEUE_WAIT_BUCKETS_S)
         self.batch_size = Histogram(batch_buckets)
         self.padding_waste = Histogram(batch_buckets)
         self.started_at = time.time()
@@ -93,6 +105,7 @@ class ServingMetrics:
                 "p50": p50, "p95": p95, "p99": p99,
                 "sum": lat["sum"], "count": lat["count"],
             },
+            "queue_wait_seconds": self.queue_wait.snapshot(),
             "batch_size": self.batch_size.snapshot(),
             "padding_waste": self.padding_waste.snapshot(),
             "uptime_seconds": time.time() - self.started_at,
@@ -151,6 +164,11 @@ class ServingMetrics:
                   "Request latency from enqueue to flush completion "
                   "(excludes HTTP reply serialization).",
                   self.latency)
+        histogram("serve_queue_wait_seconds",
+                  "Admission-queue wait per flushed request (enqueue to "
+                  "flush claim) — tail queueing visible without a "
+                  "sampled trace.",
+                  self.queue_wait)
         histogram("serve_batch_size_rows", "Real rows per flushed micro-batch.",
                   self.batch_size)
         histogram("serve_padding_waste_rows",

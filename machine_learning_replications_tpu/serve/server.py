@@ -11,15 +11,36 @@ shared micro-batcher, no third-party dependencies):
                   ``{"error": "overloaded"}`` when admission control sheds,
                   504 when an admitted request misses the request deadline
                   (it is cancelled, so the engine never computes it).
-  GET  /healthz   liveness/readiness: params family, bucket ladder, warm
-                  flag, queue depth.
+                  Every reply carries an ``X-Request-Id`` header — the
+                  inbound header's value when the client sent one (so
+                  upstream trace ids propagate, Dapper-style), a fresh id
+                  otherwise — and the whole request records a per-phase
+                  trace (``obs.reqtrace``): parse → queue wait → batch
+                  assembly → device compute (cold-compile flagged) →
+                  respond.
+  GET  /healthz   liveness/readiness *and* load signal for an external
+                  prober: params family, bucket ladder, warm flag, queue
+                  depth, uptime, and the run id from the journal manifest
+                  when one is active.
   GET  /metrics   Prometheus text exposition (``?format=json`` for the
                   same data as JSON) — ``serve.metrics``, with the
                   process-global ``obs`` registry's exposition appended
                   (jax compile counts/seconds and transfer bytes from
-                  ``obs.jaxmon``, installed at ``make_server``), so one
-                  scrape answers both "is the server shedding?" and "did
-                  it start recompiling?".
+                  ``obs.jaxmon``, installed at ``make_server``; SLO burn
+                  gauges from ``obs.slo``; flight-recorder sampling
+                  counters), so one scrape answers "is the server
+                  shedding?", "did it start recompiling?", and "how fast
+                  is the error budget burning?".
+  GET  /debug/requests
+                  the flight recorder's tail-sampled request traces
+                  (every failure + the p99-slowest completions), newest
+                  first, with recorder stats and per-SLO state. ``?n=K``
+                  caps the trace count (default 64).
+  GET  /debug/profile?seconds=N
+                  on-demand ``jax.profiler`` capture of N wall seconds
+                  (default 1) while traffic keeps flowing; replies with
+                  the artifact file list. Single-flight: a capture in
+                  progress makes concurrent calls fail fast with 409.
 
 ``ServerHandle.shutdown`` is the graceful path: stop accepting, drain the
 batcher (admitted requests are never dropped), then stop the listener.
@@ -28,7 +49,10 @@ batcher (admitted requests are never dropped), then stop the listener.
 from __future__ import annotations
 
 import json
+import os
+import tempfile
 import threading
+import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
@@ -42,7 +66,13 @@ class _Server(ThreadingHTTPServer):
     # contract this layer is built around.
     request_queue_size = 128
 
-from machine_learning_replications_tpu.obs import jaxmon
+from machine_learning_replications_tpu.obs import (
+    jaxmon,
+    journal,
+    profiler,
+    reqtrace,
+    slo,
+)
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.serve.batcher import (
     MicroBatcher,
@@ -61,13 +91,20 @@ OUTPUT_CONTRACT = "Probability of progressive HF is: {:.2f} %"
 
 
 class ServerHandle:
-    """A running serving stack: engine + batcher + metrics + HTTP listener."""
+    """A running serving stack: engine + batcher + metrics + request-trace
+    recorder + SLO tracker + HTTP listener."""
 
-    def __init__(self, engine, batcher, metrics, httpd) -> None:
+    def __init__(
+        self, engine, batcher, metrics, httpd,
+        recorder=None, slo_tracker=None, profile_dir: str | None = None,
+    ) -> None:
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
         self.httpd = httpd
+        self.recorder = recorder
+        self.slo_tracker = slo_tracker
+        self.profile_dir = profile_dir
         self._thread: threading.Thread | None = None
 
     @property
@@ -97,6 +134,7 @@ class ServerHandle:
 
 def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
     batcher, metrics, engine = handle.batcher, handle.metrics, handle.engine
+    recorder, slo_tracker = handle.recorder, handle.slo_tracker
 
     class Handler(BaseHTTPRequestHandler):
         # Persistent connections keep the loadgen's closed loop honest
@@ -113,28 +151,80 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
         # POST buffer past every bound the admission queue enforces.
         max_body_bytes = 64 * 1024
 
-        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        def _reply(
+            self, code: int, body: bytes, ctype: str,
+            request_id: str | None = None,
+        ) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            if request_id is not None:
+                # Echoed (or assigned) correlation id: the client can join
+                # its own latency record against /debug/requests samples.
+                self.send_header("X-Request-Id", request_id)
             self.end_headers()
             self.wfile.write(body)
 
-        def _json(self, code: int, obj) -> None:
+        def _json(self, code: int, obj, request_id: str | None = None) -> None:
             self._reply(
-                code, json.dumps(obj).encode(), "application/json"
+                code, json.dumps(obj).encode(), "application/json",
+                request_id=request_id,
             )
 
         def do_GET(self) -> None:  # noqa: N802 (http.server API)
             url = urlparse(self.path)
             if url.path == "/healthz":
+                jrn = journal.get_journal()
                 self._json(200, {
                     "status": "ok",
                     "params": type(engine.params).__name__,
                     "buckets": list(engine.buckets),
                     "warm": engine.warm,
                     "queue_depth": batcher.queue_depth,
+                    "uptime_seconds": round(
+                        time.time() - metrics.started_at, 3
+                    ),
+                    "run_id": (
+                        jrn.manifest.get("run_id") if jrn is not None
+                        else None
+                    ),
                 })
+            elif url.path == "/debug/requests":
+                try:
+                    n = int(parse_qs(url.query).get("n", ["64"])[0])
+                except ValueError:
+                    self._json(400, {"error": "n must be an integer"})
+                    return
+                self._json(200, {
+                    "stats": recorder.stats(),
+                    "slo": (
+                        slo_tracker.snapshot()
+                        if slo_tracker is not None else []
+                    ),
+                    "requests": recorder.snapshot(n),
+                })
+            elif url.path == "/debug/profile":
+                try:
+                    seconds = float(
+                        parse_qs(url.query).get("seconds", ["1"])[0]
+                    )
+                except ValueError:
+                    self._json(400, {"error": "seconds must be a number"})
+                    return
+                try:
+                    artifact = profiler.capture(seconds, handle.profile_dir)
+                except profiler.ProfilerBusy as exc:
+                    self._json(409, {"error": str(exc)})
+                    return
+                except ValueError as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+                except Exception as exc:  # profiler backend failure
+                    self._json(500, {
+                        "error": f"{type(exc).__name__}: {exc}",
+                    })
+                    return
+                self._json(200, artifact)
             elif url.path == "/metrics":
                 fmt = parse_qs(url.query).get("format", ["prometheus"])[0]
                 if fmt == "json":
@@ -154,6 +244,30 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
             else:
                 self._json(404, {"error": f"no such path: {url.path}"})
 
+        def _fail(
+            self, trace, status: str, code: int, message: str,
+            observe_slo: bool = True,
+        ) -> None:
+            """Terminal error path for a traced /predict request: reply
+            (respond phase stamped around the write), finish + record the
+            trace, and feed the SLO tracker (client-fault 4xx paths pass
+            ``observe_slo=False`` — a malformed body is not a served
+            request the availability objective can lose). Recording runs
+            in a finally: a client that already hung up makes the write
+            raise, and a disconnect mid-incident must not exempt the
+            request from the burn gauges or the flight recorder."""
+            t0 = time.perf_counter()
+            try:
+                self._json(
+                    code, {"error": message}, request_id=trace.request_id
+                )
+            finally:
+                trace.add_phase("respond", t0, time.perf_counter())
+                trace.finish(status, error=message)
+                if slo_tracker is not None and observe_slo:
+                    slo_tracker.observe(trace.total_s, ok=False)
+                recorder.record(trace)
+
         def do_POST(self) -> None:  # noqa: N802 (http.server API)
             if urlparse(self.path).path != "/predict":
                 # Unread body on a keep-alive connection would be parsed
@@ -165,6 +279,15 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                 validate_patient,
             )
 
+            # Request identity at admission: honor an inbound
+            # X-Request-Id (sanitized — a hostile header must not inject
+            # into logs/replies), mint one otherwise; every reply below
+            # echoes it.
+            trace = reqtrace.RequestTrace(
+                reqtrace.sanitize_request_id(
+                    self.headers.get("X-Request-Id")
+                )
+            )
             try:
                 length = int(self.headers.get("Content-Length", ""))
             except ValueError:
@@ -175,29 +298,38 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                 # even read to EOF, stalling until the socket timeout),
                 # so the connection cannot be resynced either — close it.
                 self.close_connection = True
-                self._json(400, {"error": "missing or invalid Content-Length"})
+                self._fail(
+                    trace, "bad_request", 400,
+                    "missing or invalid Content-Length", observe_slo=False,
+                )
                 return
             try:
                 if length > self.max_body_bytes:
                     # Don't read a body we've rejected: close the
                     # connection instead of draining it.
                     self.close_connection = True
-                    self._json(413, {
-                        "error": f"body exceeds {self.max_body_bytes} bytes",
-                    })
+                    self._fail(
+                        trace, "bad_request", 413,
+                        f"body exceeds {self.max_body_bytes} bytes",
+                        observe_slo=False,
+                    )
                     return
                 patient = json.loads(self.rfile.read(length) or b"{}")
                 row = validate_patient(patient)
             except (ValueError, json.JSONDecodeError) as exc:
-                self._json(400, {"error": str(exc)})
+                self._fail(
+                    trace, "bad_request", 400, str(exc), observe_slo=False
+                )
                 return
+            trace.add_phase("parse", trace.t_start, time.perf_counter())
             try:
-                future = batcher.submit(row[0])
+                future = batcher.submit(row[0], trace=trace)
             except Overloaded:
-                self._json(503, {"error": "overloaded"})
+                trace.note(shed=True)
+                self._fail(trace, "shed", 503, "overloaded")
                 return
             except RuntimeError as exc:  # closed during shutdown
-                self._json(503, {"error": str(exc)})
+                self._fail(trace, "shed", 503, str(exc))
                 return
             try:
                 prob = future.result(timeout=request_timeout_s)
@@ -206,19 +338,47 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                 # (batcher skips cancelled entries) — otherwise every
                 # deadline miss still burns an engine slot computing an
                 # answer nobody reads, compounding the overload.
-                future.cancel()
+                cancelled = future.cancel()
                 metrics.timeouts_total.inc()
-                self._json(504, {
-                    "error": f"timed out after {request_timeout_s:g}s",
-                })
+                msg = f"timed out after {request_timeout_s:g}s"
+                if cancelled:
+                    # Truly unclaimed: the wait WAS the request —
+                    # attribute it as queue time. When cancel LOSES the
+                    # claim race the flush thread is stamping its own
+                    # phases concurrently, so leave the trace to it.
+                    trace.add_phase(
+                        "queue_wait",
+                        trace.phase_end("parse", trace.t_start),
+                        time.perf_counter(),
+                    )
+                # Freeze BEFORE replying: a finished trace rejects late
+                # flush-thread stamps, so the published phases can never
+                # overlap each other or extend past t_end (_fail's
+                # respond/finish calls below become harmless no-ops).
+                trace.finish("timeout", error=msg)
+                self._fail(trace, "timeout", 504, msg)
                 return
             except Exception as exc:
-                self._json(500, {"error": str(exc)})
+                self._fail(trace, "error", 500, str(exc))
                 return
-            self._json(200, {
-                "probability": prob,
-                "text": OUTPUT_CONTRACT.format(100.0 * prob),
-            })
+            # Respond phase starts at device-compute end, so the phases
+            # partition the whole server-side interval: future-wakeup
+            # scheduling delay is response-path latency, not dead time.
+            # Recording in a finally: a hung-up client makes the write
+            # raise, and the request must still reach the SLO tracker
+            # and the flight recorder (the engine did serve it).
+            t_resp0 = trace.phase_end("device_compute", time.perf_counter())
+            try:
+                self._json(200, {
+                    "probability": prob,
+                    "text": OUTPUT_CONTRACT.format(100.0 * prob),
+                }, request_id=trace.request_id)
+            finally:
+                trace.add_phase("respond", t_resp0, time.perf_counter())
+                trace.finish("ok")
+                if slo_tracker is not None:
+                    slo_tracker.observe(trace.total_s, ok=True)
+                recorder.record(trace)
 
         def log_message(self, fmt: str, *args) -> None:
             if not quiet:
@@ -241,11 +401,24 @@ def make_server(
     request_timeout_s: float = 30.0,
     quiet: bool = True,
     say=None,
+    slos=None,
+    recorder=None,
+    trace_capacity: int = 256,
+    tail_quantile: float = 0.99,
+    profile_dir: str | None = None,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
     ``start_background``). ``max_batch_size`` defaults to the largest
     bucket so a full batch pads nothing.
+
+    Request-scoped observability: ``recorder`` (default a fresh
+    ``reqtrace.FlightRecorder(trace_capacity, tail_quantile)``) receives
+    every completed /predict trace under tail sampling; ``slos`` (default
+    ``slo.default_slos()``; pass ``[]`` to disable) declares the
+    objectives whose burn gauges ride ``/metrics``; ``profile_dir``
+    (default a per-process dir under the system temp dir) receives
+    ``/debug/profile`` captures.
 
     The listener BINDS before warmup runs: a port conflict fails in
     milliseconds instead of after the multi-second compile bill. Warmup
@@ -264,7 +437,21 @@ def make_server(
         max_queue=max_queue,
         metrics=metrics,
     )
-    handle = ServerHandle(engine, batcher, metrics, None)
+    if recorder is None:
+        recorder = reqtrace.FlightRecorder(
+            capacity=trace_capacity, tail_quantile=tail_quantile
+        )
+    if slos is None:
+        slos = slo.default_slos()
+    slo_tracker = slo.SLOTracker(slos) if slos else None
+    if profile_dir is None:
+        profile_dir = os.path.join(
+            tempfile.gettempdir(), f"mlr_profiles_{os.getpid()}"
+        )
+    handle = ServerHandle(
+        engine, batcher, metrics, None,
+        recorder=recorder, slo_tracker=slo_tracker, profile_dir=profile_dir,
+    )
     handler = _make_handler(handle, request_timeout_s, quiet)
     try:
         handle.httpd = _Server((host, port), handler)

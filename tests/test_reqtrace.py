@@ -1,0 +1,282 @@
+"""Request-scoped observability (obs.reqtrace / obs.slo / obs.profiler):
+id sanitization, tail-sampling policy, phase stamping through the
+batcher, SLO burn math, and the profiler's single-flight guard.
+
+HTTP-level coverage (request-id echo over real sockets, /debug
+endpoints, trace-merge containment, the loadgen/report join) lives in
+tests/test_serve.py next to the serving fixtures.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.obs import profiler, reqtrace, slo
+from machine_learning_replications_tpu.obs.registry import REGISTRY
+from machine_learning_replications_tpu.serve import MicroBatcher
+
+
+# ---------------------------------------------------------------------------
+# request ids
+# ---------------------------------------------------------------------------
+
+
+def test_request_id_sanitization():
+    assert reqtrace.sanitize_request_id("abc-DEF_1.2") == "abc-DEF_1.2"
+    assert reqtrace.sanitize_request_id("  padded-id ") == "padded-id"
+    # hostile or degenerate inbound ids are REPLACED, never passed through
+    for bad in (None, "", "   ", "evil\nheader", 'quo"te', "x" * 500,
+                "space inside", "läßt"):
+        rid = reqtrace.sanitize_request_id(bad)
+        assert rid != bad and len(rid) == 16
+        assert set(rid) <= set("0123456789abcdef")
+    # two generated ids never collide
+    assert reqtrace.new_request_id() != reqtrace.new_request_id()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: tail-based sampling
+# ---------------------------------------------------------------------------
+
+
+def _finished_trace(total_s: float, status: str = "ok") -> reqtrace.RequestTrace:
+    tr = reqtrace.RequestTrace()
+    tr.t_start = time.perf_counter() - total_s
+    tr.finish(status)
+    return tr
+
+
+def test_recorder_keeps_failures_and_tail_drops_fast_majority():
+    rec = reqtrace.FlightRecorder(
+        capacity=64, tail_quantile=0.9, min_window=10
+    )
+    # warmup: bootstrap keeps everything until the window can rank
+    for _ in range(10):
+        assert rec.record(_finished_trace(0.010))
+    # steady state: fast ok requests are dropped ...
+    kept_fast = sum(rec.record(_finished_trace(0.001)) for _ in range(50))
+    assert kept_fast <= 5  # ~p90 policy; a few stragglers at the boundary
+    # ... the slow tail is kept ...
+    assert rec.record(_finished_trace(0.500))
+    # ... and every failure is kept regardless of latency
+    for status in ("error", "timeout", "shed", "bad_request"):
+        assert rec.record(_finished_trace(0.0001, status=status))
+    by_status = [t["status"] for t in rec.snapshot()]
+    assert {"error", "timeout", "shed", "bad_request"} <= set(by_status)
+    stats = rec.stats()
+    assert stats["dropped_total"] >= 45
+    assert stats["tail_threshold_seconds"] is not None
+
+
+def test_recorder_ring_is_bounded_and_newest_first():
+    rec = reqtrace.FlightRecorder(capacity=8, min_window=10_000)  # all kept
+    for i in range(30):
+        tr = reqtrace.RequestTrace()
+        tr.t_start = time.perf_counter() - 0.001
+        tr.note(seq=i)  # before finish: a finished trace is immutable
+        rec.record(tr.finish("ok"))
+    snap = rec.snapshot()
+    assert len(snap) == 8
+    assert [t["seq"] for t in snap] == list(range(29, 21, -1))
+    assert rec.snapshot(3) == snap[:3]
+    assert rec.stats()["stored"] == 8 and rec.stats()["kept_total"] == 30
+
+
+def test_recorder_rejects_bad_config():
+    with pytest.raises(ValueError):
+        reqtrace.FlightRecorder(tail_quantile=1.5)
+    # capacity/window 0 must fail at construction, not as a
+    # ZeroDivisionError on the first kept trace (--trace-capacity 0)
+    with pytest.raises(ValueError):
+        reqtrace.FlightRecorder(capacity=0)
+    with pytest.raises(ValueError):
+        reqtrace.FlightRecorder(window=0)
+
+
+# ---------------------------------------------------------------------------
+# phase stamping through the batcher
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    n_features = 17
+
+    def predict(self, X):
+        time.sleep(0.002)
+        return X.mean(axis=1)
+
+    def bucket_for(self, n):
+        return 8
+
+
+def test_batcher_stamps_trace_phases_partition():
+    """The flush thread stamps queue_wait / batch_assembly /
+    device_compute; with the caller's parse and respond phases they
+    partition the request — durations sum to ≤ the end-to-end total."""
+    b = MicroBatcher(_StubEngine(), max_batch_size=4, max_wait_ms=5.0)
+    try:
+        tr = reqtrace.RequestTrace("tr-1")
+        tr.add_phase("parse", tr.t_start, time.perf_counter())
+        fut = b.submit(np.full(17, 1.0), trace=tr)
+        assert fut.result(timeout=5.0) == 1.0
+        t0 = time.perf_counter()
+        tr.add_phase("respond", tr.phase_end("device_compute", t0),
+                     time.perf_counter())
+        tr.finish("ok")
+    finally:
+        b.close()
+    ph = tr.phase_seconds()
+    assert set(ph) == set(reqtrace.PHASES)
+    assert ph["device_compute"] >= 0.002  # the stub's sleep is in there
+    total = tr.total_s
+    assert sum(ph.values()) <= total + 1e-6
+    # complete attribution: the five phases cover ≥95% of the request
+    assert sum(ph.values()) >= 0.95 * total
+    assert tr.meta["batch_rows"] == 1 and tr.meta["bucket"] == 8
+    assert tr.meta["flush_index"] == 0 and tr.meta["cold_compile"] is False
+    assert tr.meta["flush_seq"] >= 1
+
+
+def test_batcher_stamps_phases_on_engine_error():
+    class Boom:
+        n_features = 17
+
+        def predict(self, X):
+            raise RuntimeError("boom")
+
+    b = MicroBatcher(Boom(), max_batch_size=2, max_wait_ms=1.0)
+    try:
+        tr = reqtrace.RequestTrace()
+        fut = b.submit(np.full(17, 1.0), trace=tr)
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=5.0)
+    finally:
+        b.close()
+    # a failed flush still attributed the time it spent
+    assert "queue_wait" in tr.phases and "batch_assembly" in tr.phases
+
+
+def test_trace_immutable_after_finish():
+    """Once finished, a trace rejects further stamps: on the 504 path the
+    flush thread can win the cancel race and try to write compute phases
+    after the handler already closed the trace — accepting them would
+    push phase intervals past t_end and break the partition invariant."""
+    tr = reqtrace.RequestTrace()
+    tr.add_phase("parse", tr.t_start, time.perf_counter())
+    tr.finish("timeout")
+    end = tr.t_end
+    tr.add_phase("device_compute", time.perf_counter(),
+                 time.perf_counter() + 5.0)
+    tr.note(cold_compile=True)
+    tr.finish("ok")  # second finish ignored too
+    assert tr.status == "timeout" and tr.t_end == end
+    assert "device_compute" not in tr.phases and not tr.meta
+    assert sum(tr.phase_seconds().values()) <= tr.total_s + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# SLOs
+# ---------------------------------------------------------------------------
+
+
+def test_slo_declarations_validate():
+    with pytest.raises(ValueError):
+        slo.SLO("x", target=1.5)
+    with pytest.raises(ValueError):
+        slo.SLO("x", target=0.99, kind="latency")  # no threshold
+    with pytest.raises(ValueError):
+        slo.SLO("x", target=0.99, kind="nope")
+    with pytest.raises(ValueError):
+        slo.SLOTracker([slo.SLO("dup", 0.9, "availability"),
+                        slo.SLO("dup", 0.9, "availability")])
+
+
+def test_slo_burn_math():
+    """10% bad traffic against a 1% budget burns at 10×, and the
+    lifetime budget-remaining gauge integrates the damage."""
+    tracker = slo.SLOTracker(
+        [slo.SLO("lat", 0.99, "latency", threshold_s=0.1)], window=100,
+    )
+    for _ in range(90):
+        tracker.observe(0.01, ok=True)     # good
+    for _ in range(10):
+        tracker.observe(0.5, ok=True)      # too slow -> bad
+    snap = tracker.snapshot()[0]
+    assert snap["requests_total"] == 100 and snap["bad_total"] == 10
+    assert snap["window_good_ratio"] == pytest.approx(0.9)
+    assert snap["burn_rate"] == pytest.approx(10.0)
+    # budget 0.01, spent 0.10 of traffic -> 1 - 0.1/0.01 = -9 (blown)
+    assert snap["error_budget_remaining_ratio"] == pytest.approx(-9.0)
+
+
+def test_slo_availability_and_registry_exposition():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import validate_metrics
+    finally:
+        sys.path.pop(0)
+
+    tracker = slo.SLOTracker(slo.default_slos(), window=10)
+    tracker.observe(0.01, ok=True)
+    tracker.observe(0.01, ok=False)  # shed/timeout/error
+    avail = next(
+        s for s in tracker.snapshot() if s["name"] == "availability"
+    )
+    assert avail["bad_total"] == 1
+    page = REGISTRY.render_prometheus()
+    assert 'slo_burn_rate{slo="availability"}' in page
+    assert 'slo_target_ratio{slo="availability"} 0.999' in page
+    assert validate_metrics.validate(page) == [], \
+        validate_metrics.validate(page)
+
+
+# ---------------------------------------------------------------------------
+# profiler: single flight, non-empty artifact
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_rejects_bad_seconds(tmp_path):
+    with pytest.raises(ValueError):
+        profiler.capture(0.0, str(tmp_path))
+    with pytest.raises(ValueError):
+        profiler.capture(profiler.MAX_SECONDS + 1, str(tmp_path))
+
+
+def test_profiler_capture_single_flight(tmp_path):
+    """Concurrent captures: exactly one wins and returns a non-empty
+    artifact; the rest fail fast with ProfilerBusy (never queue)."""
+    import jax.numpy as jnp
+
+    results, errors = [], []
+
+    def churn():  # device work for the profiler to see
+        x = jnp.ones((32, 32))
+        for _ in range(5):
+            x = (x @ x) / 32.0
+        x.block_until_ready()
+
+    def one():
+        try:
+            churn()
+            results.append(profiler.capture(0.3, str(tmp_path)))
+        except profiler.ProfilerBusy as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 1 and len(errors) == 2
+    art = results[0]
+    assert art["total_bytes"] > 0 and art["files"]
+    assert all(f["bytes"] >= 0 for f in art["files"])
+    assert not profiler.is_busy()
+    # a second capture afterwards succeeds (the slot was released)
+    art2 = profiler.capture(0.1, str(tmp_path))
+    assert art2["profile_dir"] != art["profile_dir"]

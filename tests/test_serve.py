@@ -661,6 +661,409 @@ def test_pipeline_engine_matches_cli_route(pipeline_params, query_rows):
     assert eng.trace_counts == {1: 1, 8: 1}
 
 
+# ---------------------------------------------------------------------------
+# request-scoped observability over real sockets (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+
+def _post_with_id(url, obj, rid=None, timeout=30.0):
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), headers=headers
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("X-Request-Id"), \
+            json.loads(resp.read())
+
+
+def test_request_id_echo_and_concurrent_uniqueness(served):
+    """Every /predict reply carries X-Request-Id: an inbound id is echoed
+    verbatim; N parallel POSTs each get a UNIQUE generated id while the
+    batcher coalesces them into shared flushes; and every tail-sampled
+    trace's phase durations sum to ≤ (and nearly all of) its end-to-end
+    latency."""
+    handle, url = served
+    # inbound id echoed verbatim, Dapper-style propagation
+    _, echoed, _ = _post_with_id(
+        url + "/predict", dict(EXAMPLE_PATIENT), rid="upstream-7f3a"
+    )
+    assert echoed == "upstream-7f3a"
+
+    ids, errs = [], []
+
+    def one():
+        try:
+            _, rid, _ = _post_with_id(url + "/predict", dict(EXAMPLE_PATIENT))
+            ids.append(rid)
+        except Exception as exc:  # pragma: no cover - diagnostic aid
+            errs.append(exc)
+
+    n = 24
+    threads = [threading.Thread(target=one) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(ids) == n and len(set(ids)) == n  # unique per request
+    assert all(rid and len(rid) == 16 for rid in ids)
+    # coalescing really happened: fewer flushes than requests
+    assert handle.metrics.batches_total.value < \
+        handle.metrics.requests_total.value
+
+    status, body = _get(url + "/debug/requests")
+    assert status == 200
+    dbg = json.loads(body)
+    sampled = dbg["requests"]
+    assert sampled, "fresh recorder must have bootstrap samples"
+    for tr in sampled:
+        total = tr["total_seconds"]
+        phase_sum = sum(p["seconds"] for p in tr["phases"].values())
+        assert phase_sum <= total + 1e-6, tr
+        if tr["status"] == "ok":
+            # the five phases attribute (nearly) the whole request
+            assert set(tr["phases"]) == {
+                "parse", "queue_wait", "batch_assembly",
+                "device_compute", "respond",
+            }
+            assert phase_sum >= 0.95 * total, tr
+            assert tr["bucket"] in (1, 8)
+    # the traced requests ARE the admitted ones (join by id works): every
+    # sample on this fresh-fixture server came from a request this test
+    # sent, under the id the server echoed back
+    sampled_ids = {tr["request_id"] for tr in sampled}
+    assert sampled_ids and sampled_ids <= set(ids) | {"upstream-7f3a"}
+
+
+def test_healthz_carries_load_signal(served):
+    handle, url = served
+    _, body = _get(url + "/healthz")
+    health = json.loads(body)
+    assert health["queue_depth"] == 0
+    assert health["uptime_seconds"] >= 0
+    assert health["run_id"] is None  # no journal active
+
+    from machine_learning_replications_tpu.obs import journal
+
+    jrn = journal.RunJournal("/tmp/_serve_hz_j.jsonl", command="serve")
+    journal.set_journal(jrn)
+    try:
+        _, body = _get(url + "/healthz")
+        assert json.loads(body)["run_id"] == jrn.manifest["run_id"]
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+
+
+def test_debug_requests_keeps_failures(served):
+    """Tail sampling never drops failures: a 400 (contract violation)
+    shows up in /debug/requests with its echoed request id."""
+    _, url = served
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_with_id(url + "/predict", {"Dyspnea": 1}, rid="bad-req-1")
+    assert ei.value.code == 400
+    assert ei.value.headers.get("X-Request-Id") == "bad-req-1"
+    ei.value.read()
+    status, body = _get(url + "/debug/requests?n=200")
+    dbg = json.loads(body)
+    bad = [t for t in dbg["requests"] if t["request_id"] == "bad-req-1"]
+    assert bad and bad[0]["status"] == "bad_request"
+    assert bad[0]["sampled_reason"] == "failure"
+    # stats + SLO snapshot ride along
+    assert dbg["stats"]["kept_total"] >= 1
+    assert {s["name"] for s in dbg["slo"]} == {
+        "latency_le_250ms", "availability",
+    }
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(url + "/debug/requests?n=nope")
+    assert ei.value.code == 400
+    ei.value.read()
+
+
+def test_debug_profile_single_flight_http(served):
+    """ISSUE 3 acceptance (c): concurrent /debug/profile calls produce a
+    non-empty artifact exactly once; the losers get an immediate 409."""
+    _, url = served
+    results = []
+
+    def one():
+        try:
+            status, body = _get(url + "/debug/profile?seconds=0.4")
+            results.append((status, json.loads(body)))
+        except urllib.error.HTTPError as exc:
+            results.append((exc.code, json.loads(exc.read())))
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    codes = sorted(code for code, _ in results)
+    assert codes == [200, 409, 409], results
+    artifact = next(body for code, body in results if code == 200)
+    assert artifact["total_bytes"] > 0 and artifact["files"]
+    assert os.path.isdir(artifact["profile_dir"])
+    busy = next(body for code, body in results if code == 409)
+    assert "in flight" in busy["error"]
+    # bad inputs are 400, not capture attempts
+    for q in ("seconds=abc", "seconds=0", "seconds=1e9"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(url + f"/debug/profile?{q}")
+        assert ei.value.code == 400
+        ei.value.read()
+
+
+def test_metrics_gains_queue_wait_and_slo_families(served):
+    """The new families ride the same strict-validated /metrics page:
+    serve_queue_wait_seconds (tail queueing without a trace), slo_* burn
+    gauges, and the flight recorder's sampling counters."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import validate_metrics
+    finally:
+        _sys.path.pop(0)
+
+    _, url = served
+    _post(url + "/predict", dict(EXAMPLE_PATIENT))
+    status, text = _get(url + "/metrics")
+    assert validate_metrics.validate(text) == [], validate_metrics.validate(text)
+    assert "# TYPE serve_queue_wait_seconds histogram" in text
+    assert "serve_queue_wait_seconds_count" in text
+    assert '# TYPE slo_burn_rate gauge' in text
+    assert 'slo_error_budget_remaining_ratio{slo="availability"}' in text
+    assert "# TYPE reqtrace_sampled_total counter" in text
+    # queue-wait got observed for the flushed request
+    qw_count = next(
+        line for line in text.splitlines()
+        if line.startswith("serve_queue_wait_seconds_count")
+    )
+    assert float(qw_count.split()[-1]) >= 1
+    # the JSON snapshot carries the histogram too
+    _, body = _get(url + "/metrics?format=json")
+    snap = json.loads(body)
+    assert snap["queue_wait_seconds"]["count"] >= 1
+
+
+def test_sampled_requests_merge_under_flush_spans(stacking_params):
+    """ISSUE 3 acceptance (b): with an active tracer, sampled request
+    traces merge into the Chrome-trace export — request/phase events on
+    per-request lanes, and a req:<id> slice positionally CONTAINED in its
+    flush span (same tid, inside the flush interval), which is exactly
+    what Perfetto renders as request-nested-under-flush."""
+    from machine_learning_replications_tpu.obs import spans
+
+    tracer = spans.Tracer("test-serve-trace")
+    spans.set_tracer(tracer)
+    try:
+        handle = make_server(
+            stacking_params, port=0, buckets=(1, 8), max_wait_ms=2.0,
+        ).start_background()
+        try:
+            host, port = handle.address
+            url = f"http://{host}:{port}"
+            threads = [
+                threading.Thread(
+                    target=_post, args=(url + "/predict", dict(EXAMPLE_PATIENT))
+                )
+                for _ in range(12)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            handle.shutdown()
+    finally:
+        spans.set_tracer(None)
+    export = tracer.export()
+    evs = [e for e in export["traceEvents"] if e.get("ph") == "X"]
+    flushes = [e for e in evs if e["name"] == "serve:flush"]
+    req_slices = [e for e in evs if e["name"].startswith("req:")]
+    lanes = [e for e in evs if e["name"].startswith("request ")]
+    assert flushes and req_slices and lanes
+    # flush spans now carry their correlation annotations
+    assert all("flush_seq" in f["args"] for f in flushes)
+    assert all(f["args"]["cold_compile"] in (True, False) for f in flushes)
+    for c in req_slices:
+        assert any(
+            f["tid"] == c["tid"]
+            and f["ts"] - 1 <= c["ts"]
+            and c["ts"] + c["dur"] <= f["ts"] + f["dur"] + 1
+            for f in flushes
+        ), f"req slice {c} not contained in any flush span"
+    # lane events: each sampled request's phases are contained in its
+    # request span on the same lane tid
+    for lane_ev in lanes:
+        rid = lane_ev["args"]["request_id"]
+        phases = [
+            e for e in evs
+            if e["tid"] == lane_ev["tid"]
+            and e["args"].get("request_id") == rid
+            and e["name"] in (
+                "parse", "queue_wait", "batch_assembly",
+                "device_compute", "respond",
+            )
+        ]
+        assert phases, f"no phase events for sampled request {rid}"
+        for p in phases:
+            assert lane_ev["ts"] - 1 <= p["ts"]
+            assert p["ts"] + p["dur"] <= lane_ev["ts"] + lane_ev["dur"] + 1
+
+
+def test_timeout_trace_sampled_with_partition_intact(stacking_params):
+    """A 504'd request is always sampled (failure), and freezing the
+    trace before the reply keeps the partition invariant even when the
+    flush thread races the cancel: phases never sum past the total."""
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), max_wait_ms=1.0,
+        request_timeout_s=0.15,
+    ).start_background()
+    try:
+        real_predict = handle.engine.predict
+
+        def slow_predict(X):
+            time.sleep(0.4)  # past the 0.15 s request deadline
+            return real_predict(X)
+
+        handle.batcher._engine = type(
+            "Slow", (), {
+                "predict": staticmethod(slow_predict),
+                "bucket_for": staticmethod(handle.engine.bucket_for),
+            },
+        )()
+        host, port = handle.address
+        url = f"http://{host}:{port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_with_id(url + "/predict", dict(EXAMPLE_PATIENT),
+                          rid="will-timeout")
+        assert ei.value.code == 504
+        assert ei.value.headers.get("X-Request-Id") == "will-timeout"
+        ei.value.read()
+        assert handle.metrics.timeouts_total.value == 1
+        _, body = _get(url + "/debug/requests?n=50")
+        sample = next(
+            t for t in json.loads(body)["requests"]
+            if t["request_id"] == "will-timeout"
+        )
+        assert sample["status"] == "timeout"
+        assert sample["sampled_reason"] == "failure"
+        total = sample["total_seconds"]
+        assert total >= 0.15  # the deadline wait is in the total
+        for p in sample["phases"].values():
+            assert p["offset_seconds"] + p["seconds"] <= total + 1e-6
+        assert sum(
+            p["seconds"] for p in sample["phases"].values()
+        ) <= total + 1e-6
+    finally:
+        handle.shutdown()
+
+
+def test_cold_compile_attributed_on_trace(stacking_params):
+    """A flush that pays a bucket compile is flagged: serve without
+    warmup, and the first request's sampled trace (bootstrap keeps it)
+    carries cold_compile=True; a later same-bucket request is warm."""
+    handle = make_server(
+        stacking_params, port=0, buckets=(1,), max_wait_ms=1.0,
+        warmup=False,
+    ).start_background()
+    try:
+        host, port = handle.address
+        url = f"http://{host}:{port}"
+        _post(url + "/predict", dict(EXAMPLE_PATIENT))
+        _post(url + "/predict", dict(EXAMPLE_PATIENT))
+        _, body = _get(url + "/debug/requests")
+        samples = json.loads(body)["requests"]
+        assert len(samples) == 2
+        # newest first: the second request hit the warm executable
+        assert samples[0]["cold_compile"] is False
+        assert samples[1]["cold_compile"] is True
+    finally:
+        handle.shutdown()
+
+
+def test_loadgen_records_worst_request_ids(served, tmp_path):
+    """Satellite: the loadgen artifact carries the server-echoed ids of
+    its worst-latency requests — the join keys for /debug/requests."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    _, url = served
+    out = tmp_path / "SERVE_BENCH_ids.json"
+    rc = loadgen.main([
+        "--url", url, "--mode", "closed", "--concurrency", "3",
+        "--duration", "1.0", "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    worst = art["worst_requests"]
+    assert 0 < len(worst) <= 10
+    assert worst == sorted(worst, key=lambda w: -w["latency_ms"])
+    for w in worst:
+        assert w["status"] == "ok"
+        assert w["request_id"] and len(w["request_id"]) == 16
+        assert w["latency_ms"] > 0
+    # the join target exists: at least one worst id may be sampled; the
+    # FORMAT contract (ids comparable to trace request_ids) always holds
+    _, body = _get(url + "/debug/requests?n=500")
+    sampled_ids = {
+        t["request_id"] for t in json.loads(body)["requests"]
+    }
+    assert all(isinstance(rid, str) for rid in sampled_ids)
+
+
+def test_obs_report_joins_all_sources(served, tmp_path):
+    """tools/obs_report.py: one report from a live scrape + loadgen
+    artifact + journal, with the client-vs-server join section."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import loadgen
+        import obs_report
+    finally:
+        sys.path.pop(0)
+
+    from machine_learning_replications_tpu.obs import journal
+
+    handle, url = served
+    jrn = journal.RunJournal(tmp_path / "serve.jsonl", command="serve")
+    journal.set_journal(jrn)
+    try:
+        bench = tmp_path / "SB.json"
+        assert loadgen.main([
+            "--url", url, "--mode", "closed", "--concurrency", "3",
+            "--duration", "1.0", "--out", str(bench),
+        ]) == 0
+        report_path = tmp_path / "REPORT.md"
+        assert obs_report.main([
+            "--url", url, "--bench", str(bench),
+            "--journal", str(jrn.path), "--out", str(report_path),
+        ]) == 0
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+    report = report_path.read_text()
+    for section in (
+        "# Observability report", "## Run", "## Traffic",
+        "## Runtime (XLA accounting)", "## SLO",
+        "## Tail-sampled requests", "## Journal digest",
+        "## Bench join",
+    ):
+        assert section in report, f"missing section {section!r}"
+    assert jrn.manifest["run_id"] in report
+    assert "latency_le_250ms" in report
+    assert "flushes" in report  # journal digest saw the batcher events
+
+
 @pytest.mark.skipif(not _HAVE_REFERENCE_PKL, reason="reference pkl absent")
 def test_shipped_pickle_served_equals_cli(capsys):
     """The acceptance example: the shipped reference pickle served through

@@ -19,6 +19,13 @@ example by default, ``--patient`` for a file) and is counted as ok
 The artifact records offered/achieved qps, ok/shed/error counts, shed
 rate, and ok-latency quantiles — the serving counterpart of BENCH_*.json.
 
+The server echoes (or assigns) an ``X-Request-Id`` on every reply; the
+worst-latency request ids land in the artifact (``worst_requests``), so a
+bench artifact can be joined against the server's ``/debug/requests``
+tail samples — client-measured latency on one side, the server's
+per-phase attribution of the same request on the other
+(``tools/obs_report.py`` does the join).
+
 Example:
   python tools/loadgen.py --url http://127.0.0.1:8000 \\
       --mode closed --concurrency 8 --duration 10 \\
@@ -54,14 +61,21 @@ def _percentiles(xs: list[float], qs=(50, 95, 99)) -> dict[str, float | None]:
 
 
 class _Tally:
-    def __init__(self) -> None:
+    def __init__(self, n_worst: int = 10) -> None:
         self.lock = threading.Lock()
         self.ok_latency_ms: list[float] = []
         self.n_ok = 0
         self.n_shed = 0
         self.n_err = 0
+        self.n_worst = n_worst
+        # (latency_ms, request_id, status) for every id-carrying reply;
+        # reduced to the n_worst slowest at artifact time. One tuple per
+        # request is fine for bench durations (minutes, not days).
+        self.ided: list[tuple[float, str, str]] = []
 
-    def record(self, status: str, latency_ms: float) -> None:
+    def record(
+        self, status: str, latency_ms: float, request_id: str | None = None
+    ) -> None:
         with self.lock:
             if status == "ok":
                 self.n_ok += 1
@@ -70,6 +84,21 @@ class _Tally:
                 self.n_shed += 1
             else:
                 self.n_err += 1
+            if request_id:
+                self.ided.append((latency_ms, request_id, status))
+
+    def worst_requests(self) -> list[dict]:
+        """The slowest server-identified requests — the join keys against
+        the server's /debug/requests tail samples."""
+        with self.lock:
+            worst = sorted(self.ided, reverse=True)[: self.n_worst]
+        return [
+            {
+                "request_id": rid, "status": status,
+                "latency_ms": round(ms, 3),
+            }
+            for ms, rid, status in worst
+        ]
 
 
 def _fire(url: str, body: bytes, timeout: float, tally: _Tally) -> None:
@@ -78,16 +107,19 @@ def _fire(url: str, body: bytes, timeout: float, tally: _Tally) -> None:
         headers={"Content-Type": "application/json"},
     )
     t0 = time.monotonic()
+    rid = None
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
+            rid = resp.headers.get("X-Request-Id")
             status = "ok" if resp.status == 200 else "err"
     except urllib.error.HTTPError as exc:
         exc.read()
+        rid = exc.headers.get("X-Request-Id")
         status = "shed" if exc.code == 503 else "err"
     except Exception:
         status = "err"
-    tally.record(status, (time.monotonic() - t0) * 1000.0)
+    tally.record(status, (time.monotonic() - t0) * 1000.0, rid)
 
 
 def run_closed(url, body, duration, concurrency, timeout, tally):
@@ -202,6 +234,7 @@ def main(argv=None) -> int:
             k: None if v is None else round(v, 3)
             for k, v in _percentiles(tally.ok_latency_ms).items()
         },
+        "worst_requests": tally.worst_requests(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
     }
     line = json.dumps(artifact, indent=1)

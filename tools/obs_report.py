@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""One observability report from a run's journal, metrics, and request
+traces — the artifact a perf investigation (or a future perf PR) cites.
+
+The pieces exist separately: the JSONL run journal says what ran, the
+``/metrics`` page says what the counters did, ``/debug/requests`` holds
+the tail-sampled per-request phase breakdowns, and a loadgen
+``SERVE_BENCH_*.json`` artifact holds the *client's* view with the
+server-echoed request ids of its worst requests. This tool joins them
+into one human-readable report: run provenance, traffic and latency,
+compile/transfer accounting, SLO burn, the slowest sampled requests with
+their phase attribution, and — when a bench artifact is given — the
+client/server join: each worst-latency request id looked up in the
+sampled traces, so "the client saw 480 ms" gets an answer like "430 ms of
+it was queue wait behind a cold-bucket flush".
+
+Sources (mix live and file freely; stdlib only):
+
+  --url URL        live server: fetches /healthz, /metrics?format=json,
+                   /debug/requests
+  --journal PATH   JSONL run journal (manifest + events)
+  --metrics PATH   a saved /metrics?format=json snapshot
+  --requests PATH  a saved /debug/requests snapshot
+  --bench PATH     a loadgen SERVE_BENCH_*.json artifact (enables the join)
+  --out PATH       write the report there (default: stdout)
+
+Example:
+  python tools/loadgen.py --url http://127.0.0.1:8000 --mode closed \\
+      --duration 10 --out SERVE_BENCH.json
+  python tools/obs_report.py --url http://127.0.0.1:8000 \\
+      --bench SERVE_BENCH.json --out OBS_REPORT.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _fetch_json(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _load_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _read_journal(path: str) -> tuple[dict | None, list[dict]]:
+    manifest, events = None, []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("kind") == "manifest":
+                manifest = rec
+            else:
+                events.append(rec)
+    return manifest, events
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{1000.0 * v:.1f} ms"
+
+
+def _fmt(v, nd: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+class Report:
+    def __init__(self) -> None:
+        self.lines: list[str] = []
+
+    def h(self, title: str) -> None:
+        if self.lines:
+            self.lines.append("")
+        self.lines += [f"## {title}", ""]
+
+    def kv(self, key: str, value) -> None:
+        self.lines.append(f"- {key}: {value}")
+
+    def row(self, *cells) -> None:
+        self.lines.append("| " + " | ".join(str(c) for c in cells) + " |")
+
+    def table(self, header: tuple, rows: list[tuple]) -> None:
+        self.row(*header)
+        self.row(*["---"] * len(header))
+        for r in rows:
+            self.row(*r)
+
+    def text(self) -> str:
+        return "\n".join(["# Observability report", ""] + self.lines) + "\n"
+
+
+def _section_run(rep: Report, manifest: dict | None, health: dict | None):
+    rep.h("Run")
+    if manifest is None and health is None:
+        rep.kv("provenance", "unavailable (no --journal / --url)")
+        return
+    if manifest is not None:
+        rep.kv("run id", manifest.get("run_id"))
+        rep.kv("command", manifest.get("command"))
+        rep.kv("started", manifest.get("ts"))
+        sha = manifest.get("git_sha")
+        if sha:
+            rep.kv("git", sha[:12] + (
+                " (dirty)" if manifest.get("git_dirty") else ""
+            ))
+        versions = manifest.get("versions") or {}
+        if versions:
+            rep.kv("versions", ", ".join(
+                f"{k}={v}" for k, v in versions.items() if v
+            ))
+        if manifest.get("config_hash"):
+            rep.kv("config hash", manifest["config_hash"][:12])
+    if health is not None:
+        rep.kv("params family", health.get("params"))
+        rep.kv("bucket ladder", health.get("buckets"))
+        rep.kv("warm", health.get("warm"))
+        rep.kv("uptime", _fmt(health.get("uptime_seconds"), 1) + " s")
+        if manifest is None and health.get("run_id"):
+            rep.kv("run id", health["run_id"])
+
+
+def _section_traffic(rep: Report, metrics: dict | None):
+    rep.h("Traffic")
+    if metrics is None:
+        rep.kv("metrics", "unavailable (no --metrics / --url)")
+        return
+    for key in ("requests_total", "shed_total", "errors_total",
+                "timeouts_total", "batches_total", "queue_depth"):
+        rep.kv(key, metrics.get(key))
+    lat = metrics.get("latency_seconds") or {}
+    rep.kv("latency p50 / p95 / p99", " / ".join(
+        _ms(lat.get(q)) for q in ("p50", "p95", "p99")
+    ))
+    qw = metrics.get("queue_wait_seconds") or {}
+    if qw.get("count"):
+        rep.kv(
+            "queue wait mean",
+            _ms(qw["sum"] / qw["count"]) + f" over {qw['count']} requests",
+        )
+    batch = metrics.get("batch_size") or {}
+    if batch.get("count"):
+        rep.kv("mean flushed batch", _fmt(batch["sum"] / batch["count"], 1)
+               + " rows")
+
+
+def _section_runtime(rep: Report, runtime: dict | None):
+    rep.h("Runtime (XLA accounting)")
+    if not runtime:
+        rep.kv("runtime", "unavailable")
+        return
+    for key in ("jax_compiles_total", "jax_compile_seconds_total",
+                "jax_trace_seconds_total"):
+        if key in runtime:
+            rep.kv(key, _fmt(runtime[key]))
+    transfers = runtime.get("jax_transfer_bytes_total")
+    if isinstance(transfers, dict):
+        for labels, v in sorted(transfers.items()):
+            rep.kv(f"transfer bytes ({labels})", v)
+    captures = runtime.get("profile_captures_total")
+    if isinstance(captures, dict) and captures:
+        rep.kv("profile captures", ", ".join(
+            f"{k}={v}" for k, v in sorted(captures.items())
+        ))
+
+
+def _section_slo(rep: Report, slos: list | None):
+    rep.h("SLO")
+    if not slos:
+        rep.kv("slo", "none declared (or snapshot unavailable)")
+        return
+    rep.table(
+        ("slo", "target", "requests", "bad", "window good",
+         "burn rate", "budget left"),
+        [
+            (
+                s.get("name"), _fmt(s.get("target")),
+                s.get("requests_total"), s.get("bad_total"),
+                _fmt(s.get("window_good_ratio"), 4),
+                _fmt(s.get("burn_rate"), 2),
+                _fmt(s.get("error_budget_remaining_ratio"), 3),
+            )
+            for s in slos
+        ],
+    )
+
+
+def _phase_summary(trace: dict) -> str:
+    phases = trace.get("phases") or {}
+    parts = []
+    for name in ("parse", "queue_wait", "batch_assembly",
+                 "device_compute", "respond"):
+        if name in phases:
+            parts.append(f"{name} {_ms(phases[name].get('seconds'))}")
+    extra = []
+    if trace.get("cold_compile"):
+        extra.append("COLD COMPILE")
+    if trace.get("bucket") is not None:
+        extra.append(f"bucket {trace['bucket']}")
+    if trace.get("batch_rows") is not None:
+        extra.append(f"{trace['batch_rows']} rows")
+    tail = f"  [{', '.join(extra)}]" if extra else ""
+    return ", ".join(parts) + tail
+
+
+def _section_tail(rep: Report, requests: dict | None, n: int = 10):
+    rep.h("Tail-sampled requests (slowest first)")
+    if requests is None:
+        rep.kv("traces", "unavailable (no --requests / --url)")
+        return
+    stats = requests.get("stats") or {}
+    rep.kv(
+        "recorder",
+        f"{stats.get('kept_total')} kept / {stats.get('dropped_total')} "
+        f"dropped (tail threshold "
+        f"{_ms(stats.get('tail_threshold_seconds'))})",
+    )
+    rep.lines.append("")
+    samples = sorted(
+        requests.get("requests") or [],
+        key=lambda t: t.get("total_seconds") or 0.0, reverse=True,
+    )[:n]
+    if not samples:
+        rep.kv("traces", "none sampled yet")
+        return
+    rep.table(
+        ("request id", "status", "total", "phase breakdown"),
+        [
+            (
+                t.get("request_id"), t.get("status"),
+                _ms(t.get("total_seconds")), _phase_summary(t),
+            )
+            for t in samples
+        ],
+    )
+
+
+def _section_journal(rep: Report, events: list[dict]):
+    rep.h("Journal digest")
+    if not events:
+        rep.kv("events", "none")
+        return
+    stages = [e for e in events if e["kind"] == "stage_done"]
+    flushes = [e for e in events if e["kind"] == "flush"]
+    cold = [e for e in flushes if e.get("cold_compile")]
+    captures = [e for e in events if e["kind"] == "profile_capture"]
+    done = [e for e in events if e["kind"] in ("run_done", "run_error")]
+    rep.kv("events", len(events))
+    if stages:
+        rep.kv("stages", ", ".join(
+            f"{e['stage']} {_fmt(e.get('seconds'), 1)}s" for e in stages
+        ))
+    if flushes:
+        rows = sum(e.get("rows", 0) for e in flushes)
+        rep.kv("flushes", f"{len(flushes)} ({rows} rows, "
+               f"{len(cold)} cold-compile)")
+    if captures:
+        rep.kv("profile captures", len(captures))
+    for e in done:
+        rep.kv(e["kind"], {
+            k: v for k, v in e.items() if k not in ("kind", "ts")
+        })
+
+
+def _section_join(rep: Report, bench: dict | None, requests: dict | None):
+    if bench is None:
+        return
+    rep.h("Bench join (client worst requests vs server traces)")
+    rep.kv("bench mode", bench.get("mode"))
+    rep.kv("achieved qps", bench.get("achieved_qps"))
+    lat = bench.get("latency_ms") or {}
+    rep.kv("client latency p50 / p95 / p99", " / ".join(
+        f"{lat.get(q)} ms" if lat.get(q) is not None else "-"
+        for q in ("p50", "p95", "p99")
+    ))
+    worst = bench.get("worst_requests") or []
+    if not worst:
+        rep.kv("worst_requests", "absent (pre-join loadgen artifact?)")
+        return
+    by_id = {
+        t.get("request_id"): t
+        for t in (requests or {}).get("requests") or []
+    }
+    rep.lines.append("")
+    rows = []
+    for w in worst:
+        trace = by_id.get(w.get("request_id"))
+        rows.append((
+            w.get("request_id"), w.get("status"),
+            f"{w.get('latency_ms')} ms",
+            _phase_summary(trace) if trace else
+            "not sampled (below tail threshold, or evicted)",
+        ))
+    rep.table(
+        ("request id", "client status", "client latency", "server phases"),
+        rows,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--url", help="live server base URL")
+    ap.add_argument("--journal", help="JSONL run journal path")
+    ap.add_argument("--metrics", help="saved /metrics?format=json snapshot")
+    ap.add_argument("--requests", help="saved /debug/requests snapshot")
+    ap.add_argument("--bench", help="loadgen SERVE_BENCH_*.json artifact")
+    ap.add_argument("--tail", type=int, default=10,
+                    help="slowest sampled traces to show")
+    ap.add_argument("--out", help="report path (default: stdout)")
+    args = ap.parse_args(argv)
+    if not (args.url or args.journal or args.metrics or args.requests):
+        ap.error("nothing to report on: give --url and/or input files")
+
+    health = metrics = requests = None
+    if args.url:
+        base = args.url.rstrip("/")
+        health = _fetch_json(base + "/healthz")
+        metrics = _fetch_json(base + "/metrics?format=json")
+        # Ask for everything the recorder holds (its ring caps the
+        # count): the endpoint's n=64 default would silently drop the
+        # very samples the Bench join needs.
+        requests = _fetch_json(base + "/debug/requests?n=1000000")
+    if args.metrics:
+        metrics = _load_json(args.metrics)
+    if args.requests:
+        requests = _load_json(args.requests)
+    manifest, events = (
+        _read_journal(args.journal) if args.journal else (None, [])
+    )
+    bench = _load_json(args.bench) if args.bench else None
+
+    rep = Report()
+    _section_run(rep, manifest, health)
+    _section_traffic(rep, metrics)
+    _section_runtime(rep, (metrics or {}).get("runtime"))
+    slos = (requests or {}).get("slo")
+    _section_slo(rep, slos)
+    _section_tail(rep, requests, n=args.tail)
+    if args.journal:
+        _section_journal(rep, events)
+    _section_join(rep, bench, requests)
+
+    text = rep.text()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"report written to {args.out}", file=sys.stderr)
+    else:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
