@@ -275,6 +275,9 @@ def cmd_serve(args) -> int:
         "trace_capacity": args.trace_capacity,
         "tail_quantile": args.tail_quantile,
         "profile_dir": args.profile_dir,
+        "no_quality": args.no_quality,
+        "drift_warn_psi": args.drift_warn_psi,
+        "drift_alert_psi": args.drift_alert_psi,
     }, sort_keys=True)
     with _observed(args, "serve", config_json=serve_cfg):
         return _run_serve(args, buckets)
@@ -310,6 +313,9 @@ def _run_serve(args, buckets) -> int:
         trace_capacity=args.trace_capacity,
         tail_quantile=args.tail_quantile,
         profile_dir=args.profile_dir,
+        no_quality=args.no_quality,
+        drift_warn_psi=args.drift_warn_psi,
+        drift_alert_psi=args.drift_alert_psi,
     )
     host, port = handle.address
     print(
@@ -539,6 +545,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None,
         help="directory for /debug/profile captures (default: a "
         "per-process dir under the system temp dir)",
+    )
+    v.add_argument(
+        "--no-quality", action="store_true",
+        help="disable model-quality drift monitoring even when the "
+        "checkpoint carries a training reference profile (no quality_* "
+        "families, /debug/quality reports disabled)",
+    )
+    v.add_argument(
+        "--drift-warn-psi", type=float, default=0.1,
+        help="PSI at or above which drift status becomes 'warn' (0.1 is "
+        "the industry convention: the population is moving; "
+        "docs/OBSERVABILITY.md 'Model quality')",
+    )
+    v.add_argument(
+        "--drift-alert-psi", type=float, default=0.25,
+        help="PSI at or above which drift status becomes 'alert' (served "
+        "cohort no longer resembles the training cohort)",
     )
     v.add_argument("--verbose", action="store_true", help="log each request")
     add_obs_flags(v)

@@ -48,11 +48,21 @@ from machine_learning_replications_tpu.utils.cv import (
 
 @flax.struct.dataclass
 class PipelineParams:
-    """Everything needed to go from a raw 64-feature row to a probability."""
+    """Everything needed to go from a raw 64-feature row to a probability.
+
+    ``quality`` is the model's training-time reference profile
+    (``obs.quality.build_reference_profile`` — per-feature histograms/
+    moments/quantiles over the post-impute post-select ``X[n, 17]`` plus
+    the training score distribution), a plain dict-of-arrays pytree so the
+    checkpoint sidecar carries it with no new registry class. It defaults
+    to ``None`` so checkpoints written before the profile existed restore
+    into the same class (``persist.orbax_io`` journals the gap); serving
+    disables quality monitoring when it is absent."""
 
     imputer: knn_impute.KNNImputerParams
     support_mask: jnp.ndarray  # [64] bool — Lasso-selected features
     ensemble: stacking.StackingParams
+    quality: Any = None  # dict[str, array] reference profile, or None
 
 
 class _NullStages:
@@ -600,12 +610,45 @@ def fit_pipeline(
     }
     if len(sel) > 6 and int(sel[6]) >= 0:
         info["subsampled_from_rows"] = int(sel[6])
-    ens = fit_stacking(X_imp[:, mask], y, cfg, mesh=mesh, stages=stages)
+    X17 = X_imp[:, mask]
+    ens = fit_stacking(X17, y, cfg, mesh=mesh, stages=stages)
+
+    def _quality_profile():
+        # The model's drift baseline (obs.quality): the SAME post-impute
+        # post-select matrix the members trained on, plus the fitted
+        # ensemble's training score distribution — computed here because
+        # this is the only place both exist before the params leave for a
+        # checkpoint. One chunked predict pass over the cohort; at the
+        # 10M-row scale that is bounded by the same chunk_rows memory
+        # story as batch prediction.
+        from machine_learning_replications_tpu.obs import quality
+
+        scores = _ensemble_scores(
+            ens, X17, mesh=mesh, chunk_rows=cfg.svc.predict_chunk_rows
+        )
+        prof = quality.build_reference_profile(X17, scores, y=y)
+        return {k: jnp.asarray(v) for k, v in prof.items()}
+
+    qual = stages.run("quality_profile", _quality_profile)
     return (
         PipelineParams(
-            imputer=imp_p, support_mask=jnp.asarray(mask), ensemble=ens
+            imputer=imp_p, support_mask=jnp.asarray(mask), ensemble=ens,
+            quality=qual,
         ),
         {"selection": info, "n_selected": int(mask.sum())},
+    )
+
+
+def _ensemble_scores(
+    ens: stacking.StackingParams, X17: np.ndarray, mesh=None,
+    chunk_rows: int | None = None,
+) -> np.ndarray:
+    """Training scores for the reference profile: the stacked P(class 1)
+    over already-imputed-and-selected rows, through the SAME bounded
+    scoring tail as batch inference (callers pass the experiment's own
+    ``cfg.svc.predict_chunk_rows``)."""
+    return np.asarray(
+        _stacked_proba1_bounded(ens, jnp.asarray(X17), mesh, chunk_rows)
     )
 
 
@@ -684,27 +727,36 @@ def pipeline_predict_proba1(
     [rows, n_support] RBF kernel block, which at cohort scale must not be
     built for every row at once (default: ``SVCConfig.predict_chunk_rows``).
     """
+    X17 = impute_select(params, X64, mesh=mesh)
+    return _stacked_proba1_bounded(params.ensemble, X17, mesh, chunk_rows)
+
+
+def _stacked_proba1_bounded(
+    ens: stacking.StackingParams, X17: jnp.ndarray, mesh,
+    chunk_rows: int | None,
+) -> jnp.ndarray:
+    """The ONE memory-bounded stacked-probability tail (batch inference
+    and the fit-time reference-profile scoring pass both run it): with a
+    mesh, row-sharded over the 'data' axis; single-device, chunked so the
+    SVC member's [rows, n_support] kernel block stays within
+    ``chunk_rows`` (default ``SVCConfig.predict_chunk_rows``); blocks
+    stay as device arrays until the final concatenate."""
     from machine_learning_replications_tpu.config import SVCConfig
 
     if chunk_rows is None:
         chunk_rows = SVCConfig().predict_chunk_rows
-    X17 = impute_select(params, X64, mesh=mesh)
     if mesh is not None:
         from machine_learning_replications_tpu.parallel.rowwise import (
             apply_rows_sharded,
         )
 
         return apply_rows_sharded(
-            mesh, stacking.predict_proba1, params.ensemble, X17,
-            chunk_rows=chunk_rows,
+            mesh, stacking.predict_proba1, ens, X17, chunk_rows=chunk_rows
         )
     n = int(X17.shape[0])
     if n > chunk_rows:
-        # single-device chunking honors the same memory bound; blocks stay
-        # as device arrays until the final concatenate
-        blocks = [
-            stacking.predict_proba1(params.ensemble, X17[s : s + chunk_rows])
+        return jnp.concatenate([
+            stacking.predict_proba1(ens, X17[s : s + chunk_rows])
             for s in range(0, n, chunk_rows)
-        ]
-        return jnp.concatenate(blocks)
-    return stacking.predict_proba1(params.ensemble, X17)
+        ])
+    return stacking.predict_proba1(ens, X17)

@@ -43,6 +43,20 @@ def predict_proba1(params: StackingParams, X: jnp.ndarray) -> jnp.ndarray:
     return linear.predict_proba1(params.meta, member_probas(params, X))
 
 
+def predict_proba1_with_members(
+    params: StackingParams, X: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(p1[n], members[n, 3])`` — the blended probability plus the member
+    meta-features it was blended from. Member outputs are already computed
+    on the way to ``p1``; exposing them costs nothing extra and feeds the
+    serving quality monitor's ensemble-agreement tracking
+    (``obs.quality``): mean pairwise member disagreement is a drift signal
+    the blended probability alone hides (members can move in opposite
+    directions and cancel)."""
+    m = member_probas(params, X)
+    return linear.predict_proba1(params.meta, m), m
+
+
 def predict_proba(params: StackingParams, X: jnp.ndarray) -> jnp.ndarray:
     """``[n, 2]`` = [1−p, p], matching sklearn's column layout
     (``predict_hf.py:36-40`` reads column 1)."""

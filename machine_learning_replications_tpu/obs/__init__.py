@@ -35,6 +35,13 @@ layer every other layer reports into:
                 error-budget burn gauges exported through the registry.
   ``profiler``  on-demand ``jax.profiler`` capture with a single-flight
                 guard (the serving ``/debug/profile`` endpoint).
+  ``quality``   model-quality monitoring: training-time reference profiles
+                (per-feature histograms/moments/quantiles + score
+                distribution, carried inside the checkpoint), streaming
+                PSI/KS drift vs the reference, calibration-bins snapshot,
+                and ensemble-agreement tracking — ``quality_*`` registry
+                families, the serving ``/debug/quality`` endpoint, and
+                journaled ``ok``/``warn``/``alert`` status transitions.
 
 Importing this package (or ``journal``/``registry``) never imports jax:
 ``bench.py``'s orchestrator — which must not touch the flaky TPU plugin —
@@ -45,6 +52,7 @@ from machine_learning_replications_tpu.obs import (  # noqa: F401
     jaxmon,
     journal,
     profiler,
+    quality,
     registry,
     reqtrace,
     slo,
@@ -52,5 +60,6 @@ from machine_learning_replications_tpu.obs import (  # noqa: F401
 )
 
 __all__ = [
-    "jaxmon", "journal", "profiler", "registry", "reqtrace", "slo", "spans",
+    "jaxmon", "journal", "profiler", "quality", "registry", "reqtrace",
+    "slo", "spans",
 ]

@@ -19,7 +19,11 @@ fixes both:
     multi-hour log with time-of-day-only local stamps is ambiguous across
     midnight and timezones), ``kind`` plus event-specific fields. The
     stage runners emit ``stage_start`` / ``stage_done`` /
-    ``checkpoint_restore``; the serving batcher emits ``flush``.
+    ``checkpoint_restore``; the serving batcher emits ``flush``; the
+    model-quality monitor emits ``quality_status`` on every
+    ``ok``/``warn``/``alert`` drift transition, and restoring a
+    pre-profile checkpoint emits ``quality_profile_missing``
+    (``obs.quality``, ``persist.orbax_io``).
 
 ``stage_scope`` is the deduplication point the stage runners share: the
 same stderr lines ``models.pipeline._NullStages`` and

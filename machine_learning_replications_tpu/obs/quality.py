@@ -1,0 +1,708 @@
+"""Model-quality observability: reference profiles, streaming drift, and
+ensemble-agreement monitoring (docs/OBSERVABILITY.md "Model quality").
+
+The serving stack's first two telemetry pillars — system spans (PR 2) and
+request traces (PR 3) — can say *how fast* an answer came back, but
+nothing about whether the patients being scored still look like the
+cohort the ensemble was fit on. For a clinical model behind a 17-variable
+contract, silent input drift (a referral-pattern change, an upstream
+unit-conversion bug) or a collapsing score distribution is exactly the
+failure mode latency SLOs cannot see. This module is the third pillar:
+
+  * **Reference profile** — built at fit time by ``models.pipeline`` over
+    the post-impute, post-select ``X[n, 17]`` and the training score
+    distribution, and carried *inside* the checkpoint
+    (``PipelineParams.quality``, a plain dict-of-arrays pytree the Orbax
+    sidecar already knows how to encode), so every served model ships its
+    own baseline. ``build_reference_profile`` is numpy-only: this module
+    (like the rest of ``obs``) never imports jax.
+  * **Streaming accumulators** — ``QualityMonitor.observe_batch`` takes
+    each flushed batch's contract rows, blended probabilities, and
+    per-member probabilities from the serving engine. Bin indices are
+    vectorized *outside* the lock; the lock guards only bounded ring
+    writes and snapshot copies (the batcher's flush thread must never
+    queue behind drift math).
+  * **Drift statistics** — per-feature PSI and (binned) KS distance of
+    the recent window vs the reference, score-distribution PSI, a
+    calibration-bins snapshot, and mean pairwise member disagreement.
+    Exported as ``quality_*`` families through the process-global
+    registry (validator-clean) and as the ``/debug/quality`` payload;
+    status transitions (``ok``/``warn``/``alert``) are journaled.
+
+**Binning.** Feature histograms use ``DEFAULT_FEATURE_BINS`` equal-width
+bins between the training min and max, with out-of-range values clipped
+into the edge bins. Equal-width (rather than the decile convention some
+PSI write-ups use) keeps every profile array a fixed shape — binary
+clinical flags collapse deciles to two distinct edges — and makes the
+serving-side bin index one vectorized multiply-clip per batch. Scores bin
+on fixed edges over [0, 1].
+
+**PSI thresholds.** The defaults follow the industry convention: PSI
+below 0.1 is population noise (``ok``), 0.1–0.25 means the population is
+moving and the model's operating point should be reviewed (``warn``),
+above 0.25 the served cohort no longer resembles the training cohort and
+scores should not be trusted without re-validation (``alert``). For this
+model the clinically scary version of the failure is concrete: an EHR
+feed that starts reporting wall thickness in different units, or a
+referral shift toward sicker patients, silently moves every probability
+while every latency dashboard stays green.
+
+**Calibration snapshot semantics.** Serving has no labels, so true
+calibration cannot be measured online. The reference profile therefore
+stores, per training-score bin, the *training* positive rate; the monitor
+reports serving-side count and mean predicted score per bin next to it.
+A stable population scored by a calibrated model keeps the serving mass
+and mean-score per bin near training; mass migrating across bins is the
+score-PSI signal, and a growing gap between mean predicted score and the
+training positive rate in heavily-populated bins is the label-free
+calibration drift proxy.
+
+Low-count honesty: below ``min_rows`` window rows (default 200 — with 10
+bins, sampling noise alone sits near E[PSI] ≈ (B−1)/n ≈ 0.045 at n=200,
+safely under the 0.1 warn line; judging at a few dozen rows was measured
+to flap ok→alert→ok on pure startup noise), every drift statistic is
+``None`` in JSON payloads (never NaN — the PR 1 strict-JSON convention)
+and ``NaN`` on the Prometheus gauges (the idiomatic "no data" sample
+value, legal for gauges under the strict validator).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Sequence
+
+import numpy as np
+
+from machine_learning_replications_tpu.obs import journal
+from machine_learning_replications_tpu.obs.registry import (
+    REGISTRY,
+    MetricsRegistry,
+)
+
+PROFILE_VERSION = 1
+DEFAULT_FEATURE_BINS = 10
+DEFAULT_SCORE_BINS = 10
+#: Quantile levels stored per feature (diagnostics for /debug/quality and
+#: obs_report; the drift statistics themselves run on the histograms).
+PROFILE_QUANTILES = (0.01, 0.05, 0.25, 0.5, 0.75, 0.95, 0.99)
+
+#: Industry-convention PSI thresholds (module docstring has the rationale).
+DEFAULT_WARN_PSI = 0.1
+DEFAULT_ALERT_PSI = 0.25
+
+_STATUS_LEVEL = {"ok": 0, "warn": 1, "alert": 2}
+
+
+# ---------------------------------------------------------------------------
+# Reference profile
+# ---------------------------------------------------------------------------
+
+
+def build_reference_profile(
+    X: np.ndarray,
+    scores: np.ndarray,
+    y: np.ndarray | None = None,
+    feature_bins: int = DEFAULT_FEATURE_BINS,
+    score_bins: int = DEFAULT_SCORE_BINS,
+) -> dict[str, np.ndarray]:
+    """The training-time baseline a served model carries: per-feature
+    equal-width histograms + moments + quantiles over ``X[n, F]`` (the
+    post-impute, post-select ensemble input), the training score
+    histogram over fixed [0, 1] bins, and — when training labels ``y``
+    are given — the per-score-bin positive rate (the calibration
+    reference; NaN-filled without labels).
+
+    Returns a plain ``{str: np.ndarray}`` pytree (scalars as 0-d arrays)
+    so the profile rides any checkpoint path that can carry a dict of
+    arrays — ``persist.orbax_io``'s sidecar encodes it as a ``mapping``
+    node with no new registry class.
+    """
+    X = np.asarray(X, np.float64)
+    if X.ndim != 2 or X.shape[0] < 1:
+        raise ValueError(f"profile needs a non-empty [n, F] matrix, got {X.shape}")
+    if not np.isfinite(X).all():
+        raise ValueError(
+            "profile input must be post-impute (finite); found NaN/Inf"
+        )
+    scores = np.asarray(scores, np.float64).ravel()
+    if scores.shape[0] != X.shape[0]:
+        raise ValueError(
+            f"scores length {scores.shape[0]} != rows {X.shape[0]}"
+        )
+    n, F = X.shape
+    B, S = int(feature_bins), int(score_bins)
+    if B < 2 or S < 2:
+        raise ValueError("feature_bins and score_bins must be >= 2")
+
+    mins = X.min(axis=0)
+    maxs = X.max(axis=0)
+    # Degenerate (constant) columns get a unit-width span so the bin
+    # arithmetic stays finite; all mass lands in bin 0 on both sides and
+    # the feature contributes PSI 0 until it actually moves.
+    widths = np.where(maxs > mins, maxs - mins, 1.0)
+    edges = mins[:, None] + widths[:, None] * (
+        np.arange(B + 1, dtype=np.float64)[None, :] / B
+    )
+    counts = np.stack(
+        [np.bincount(c, minlength=B) for c in _feature_bin_indices(X, mins, widths, B).T]
+    ).astype(np.float64)
+
+    q = np.asarray(PROFILE_QUANTILES, np.float64)
+    score_edges = np.linspace(0.0, 1.0, S + 1)
+    s_idx = _score_bin_indices(scores, S)
+    score_counts = np.bincount(s_idx, minlength=S).astype(np.float64)
+    calib_pos_rate = np.full(S, np.nan)
+    calib_mean_score = np.full(S, np.nan)
+    for b in range(S):
+        m = s_idx == b
+        if m.any():
+            calib_mean_score[b] = float(scores[m].mean())
+            if y is not None:
+                calib_pos_rate[b] = float(np.asarray(y, np.float64)[m].mean())
+
+    return {
+        "version": np.asarray(PROFILE_VERSION, np.int64),
+        "n_rows": np.asarray(n, np.int64),
+        "bin_edges": edges,                      # [F, B+1]
+        "bin_counts": counts,                    # [F, B]
+        "mean": X.mean(axis=0),
+        "std": X.std(axis=0),
+        "minimum": mins,
+        "maximum": maxs,
+        "quantile_levels": q,
+        "quantiles": np.quantile(X, q, axis=0).T,  # [F, Q]
+        "score_edges": score_edges,              # [S+1]
+        "score_counts": score_counts,            # [S]
+        "calib_mean_score": calib_mean_score,    # [S] training mean score/bin
+        "calib_pos_rate": calib_pos_rate,        # [S] training pos rate/bin
+    }
+
+
+def _feature_bin_indices(
+    X: np.ndarray, mins: np.ndarray, widths: np.ndarray, n_bins: int
+) -> np.ndarray:
+    """Equal-width bin index per value, out-of-range clipped into the edge
+    bins — one vectorized multiply/clip, the whole per-batch binning cost."""
+    idx = np.floor((X - mins[None, :]) / widths[None, :] * n_bins)
+    return np.clip(idx, 0, n_bins - 1).astype(np.int16)
+
+
+def _score_bin_indices(scores: np.ndarray, n_bins: int) -> np.ndarray:
+    idx = np.floor(np.asarray(scores, np.float64) * n_bins)
+    return np.clip(idx, 0, n_bins - 1).astype(np.int16)
+
+
+def _as_host_profile(profile: Any) -> dict[str, np.ndarray]:
+    """Coerce a restored profile pytree (possibly jax arrays fresh off a
+    checkpoint) to host numpy and sanity-check the keys this module needs."""
+    if not isinstance(profile, dict):
+        raise TypeError(
+            f"quality profile must be a dict pytree, got {type(profile).__name__}"
+        )
+    prof = {k: np.asarray(v) for k, v in profile.items()}
+    needed = ("bin_edges", "bin_counts", "score_edges", "score_counts", "n_rows")
+    missing = [k for k in needed if k not in prof]
+    if missing:
+        raise ValueError(f"quality profile missing keys: {missing}")
+    version = int(prof.get("version", 1))
+    if version > PROFILE_VERSION:
+        raise ValueError(
+            f"quality profile version {version} is newer than this build "
+            f"supports ({PROFILE_VERSION})"
+        )
+    return prof
+
+
+# ---------------------------------------------------------------------------
+# Drift statistics
+# ---------------------------------------------------------------------------
+
+
+def psi(
+    expected_counts: Sequence[float],
+    actual_counts: Sequence[float],
+    eps: float = 1e-4,
+) -> float:
+    """Population Stability Index between two histograms on shared bins:
+    ``sum((p_a − p_e) · ln(p_a / p_e))``. Proportions are floored at
+    ``eps`` (the standard zero-bin smoothing) so an empty bin on either
+    side contributes a large-but-finite term instead of ±inf."""
+    e = np.asarray(expected_counts, np.float64)
+    a = np.asarray(actual_counts, np.float64)
+    if e.shape != a.shape or e.ndim != 1:
+        raise ValueError(f"histogram shapes differ: {e.shape} vs {a.shape}")
+    if e.sum() <= 0 or a.sum() <= 0:
+        raise ValueError("psi needs non-empty histograms on both sides")
+    p_e = np.maximum(e / e.sum(), eps)
+    p_a = np.maximum(a / a.sum(), eps)
+    return float(np.sum((p_a - p_e) * np.log(p_a / p_e)))
+
+
+def ks_binned(
+    expected_counts: Sequence[float], actual_counts: Sequence[float]
+) -> float:
+    """Kolmogorov–Smirnov distance between two *binned* distributions:
+    the max |CDF difference| evaluated at the shared bin edges. A lower
+    bound on the exact sample KS (within-bin detail is quantized away),
+    which is the right trade for a streaming monitor that stores counts,
+    not rows."""
+    e = np.asarray(expected_counts, np.float64)
+    a = np.asarray(actual_counts, np.float64)
+    if e.shape != a.shape or e.ndim != 1:
+        raise ValueError(f"histogram shapes differ: {e.shape} vs {a.shape}")
+    if e.sum() <= 0 or a.sum() <= 0:
+        raise ValueError("ks needs non-empty histograms on both sides")
+    return float(
+        np.abs(np.cumsum(e) / e.sum() - np.cumsum(a) / a.sum()).max()
+    )
+
+
+def _round(v: float | None, nd: int = 6) -> float | None:
+    return None if v is None else round(float(v), nd)
+
+
+def _null_if_nan(v: float) -> float | None:
+    return None if v != v else float(v)
+
+
+# ---------------------------------------------------------------------------
+# Streaming monitor
+# ---------------------------------------------------------------------------
+
+
+class QualityMonitor:
+    """Sliding-window drift monitor the serving engine feeds per flush.
+
+    State is three bounded rings over the last ``window`` *real* (unpadded)
+    rows: per-feature bin indices (``[window, F]`` int16), score bin index
+    + raw score, and per-row mean pairwise member disagreement. Rings make
+    the windowed histograms exact (no decay-factor tuning), bound memory
+    explicitly (~40 bytes/row at F=17), and keep ``observe_batch`` to one
+    vectorized binning pass outside the lock plus ring writes inside it —
+    the same bounded-over-unbounded discipline as the admission queue.
+
+    Drift statistics refresh at most once per ``refresh_rows`` observed
+    rows (and always on ``snapshot()``): gauges, status, and the journaled
+    ``quality_status`` transition event all come from the refresh path, so
+    a high-qps flush loop pays ring writes, not PSI math, per batch.
+    """
+
+    def __init__(
+        self,
+        profile: Any,
+        warn_psi: float = DEFAULT_WARN_PSI,
+        alert_psi: float = DEFAULT_ALERT_PSI,
+        window: int = 2048,
+        min_rows: int = 200,
+        refresh_rows: int = 32,
+        feature_names: Sequence[str] | None = None,
+        registry: MetricsRegistry | None = None,
+    ) -> None:
+        self._profile = _as_host_profile(profile)
+        F, B = self._profile["bin_counts"].shape
+        self._F, self._B = F, B
+        self._S = int(self._profile["score_counts"].shape[0])
+        if not 0 < warn_psi <= alert_psi:
+            raise ValueError(
+                f"need 0 < warn_psi <= alert_psi, got {warn_psi} / {alert_psi}"
+            )
+        if window < 1 or min_rows < 1 or refresh_rows < 1:
+            raise ValueError("window, min_rows, refresh_rows must be >= 1")
+        if window < min_rows:
+            # A window that can never reach min_rows would pin every drift
+            # statistic at "not enough data" forever — monitoring silently
+            # off while /healthz keeps saying ok. Refuse at construction.
+            raise ValueError(
+                f"window ({window}) must be >= min_rows ({min_rows}), or "
+                "the drift statistics can never be computed"
+            )
+        self.warn_psi = float(warn_psi)
+        self.alert_psi = float(alert_psi)
+        self.window = int(window)
+        self.min_rows = int(min_rows)
+        self.refresh_rows = int(refresh_rows)
+        if feature_names is None:
+            from machine_learning_replications_tpu.data.schema import SELECTED_17
+
+            feature_names = (
+                SELECTED_17 if len(SELECTED_17) == F
+                else tuple(f"f{i}" for i in range(F))
+            )
+        if len(feature_names) != F:
+            raise ValueError(
+                f"{len(feature_names)} feature names for {F} features"
+            )
+        self.feature_names = tuple(str(n) for n in feature_names)
+        self._mins = self._profile["bin_edges"][:, 0]
+        self._widths = (
+            self._profile["bin_edges"][:, -1] - self._mins
+        )
+        self._widths = np.where(self._widths > 0, self._widths, 1.0)
+
+        self._lock = threading.Lock()
+        # Serializes whole refresh passes (copy → compute → commit): the
+        # batcher flush thread and /debug/quality handler threads both
+        # refresh, and unserialized passes could commit a STALE window's
+        # statistics over a fresher one — overwriting real drift gauges
+        # and journaling phantom recovery transitions.
+        self._refresh_lock = threading.Lock()
+        self._feat_ring = np.zeros((self.window, F), np.int16)
+        self._score_ring = np.zeros(self.window, np.int16)
+        self._score_val_ring = np.zeros(self.window, np.float64)
+        self._dis_ring = np.full(self.window, np.nan)
+        self._rows = 0        # ring-write cursor (truncated-batch rows)
+        self._rows_total = 0  # every real row ever observed
+        self._last_refresh_rows = 0
+        self._status = "ok"
+        self._disabled_reason: str | None = None  # set by disable()
+        # Last refresh's derived statistics (NaN = not computable yet).
+        self._feature_psi = np.full(F, np.nan)
+        self._feature_ks = np.full(F, np.nan)
+        self._score_psi = float("nan")
+        self._disagreement = float("nan")
+
+        reg = registry or REGISTRY
+        self._g_feature_psi = reg.gauge(
+            "quality_feature_psi",
+            "Windowed PSI of the feature vs its training reference "
+            "histogram (NaN until min_rows).",
+            labels=("feature",),
+        )
+        self._g_feature_ks = reg.gauge(
+            "quality_feature_ks",
+            "Windowed binned KS distance of the feature vs its training "
+            "reference (NaN until min_rows).",
+            labels=("feature",),
+        )
+        self._g_score_psi = reg.gauge(
+            "quality_score_psi",
+            "Windowed PSI of the predicted-probability distribution vs "
+            "the training score distribution (NaN until min_rows).",
+        )
+        self._g_disagreement = reg.gauge(
+            "quality_member_disagreement",
+            "Windowed mean pairwise |p_i - p_j| across ensemble members "
+            "(NaN until min_rows or without member outputs).",
+        )
+        self._g_window = reg.gauge(
+            "quality_window_rows", "Real rows in the sliding drift window."
+        )
+        self._g_status = reg.gauge(
+            "quality_status",
+            "Drift status: 0 = ok, 1 = warn, 2 = alert (worst PSI vs the "
+            "configured thresholds).",
+        )
+        self._c_rows = reg.counter(
+            "quality_rows_total", "Real (unpadded) rows observed by the "
+            "quality monitor."
+        )
+        self._c_transitions = reg.counter(
+            "quality_status_transitions_total",
+            "Drift status transitions, labeled by the state entered.",
+            labels=("to",),
+        )
+        # Materialize every series now: a scrape taken before traffic (or
+        # before min_rows) must show the families, with NaN marking
+        # "no data yet" on the drift gauges (legal for gauges; the JSON
+        # payloads render these as null).
+        for name in self.feature_names:
+            self._g_feature_psi.set(float("nan"), feature=name)
+            self._g_feature_ks.set(float("nan"), feature=name)
+        self._g_score_psi.get().set(float("nan"))
+        self._g_disagreement.get().set(float("nan"))
+        self._g_window.get().set(0.0)
+        self._g_status.get().set(0.0)
+        self._c_rows.get()
+        for s in ("ok", "warn", "alert"):
+            self._c_transitions.labels(to=s)
+
+    # -- ingest -------------------------------------------------------------
+
+    def observe_batch(
+        self,
+        X: np.ndarray,
+        p1: np.ndarray,
+        members: np.ndarray | None = None,
+    ) -> None:
+        """Feed one flushed batch of real rows: ``X[n, F]`` contract-space
+        rows (post-impute/post-select for the pipeline route), ``p1[n]``
+        blended probabilities, ``members[n, M]`` per-member probabilities
+        (None when the served family has no members, e.g. a bare GBDT).
+        Binning is vectorized out of the lock; the lock covers only the
+        ring writes."""
+        X = np.asarray(X, np.float64)
+        p1 = np.asarray(p1, np.float64).ravel()
+        n = X.shape[0]
+        if n == 0:
+            return
+        if X.ndim != 2 or X.shape[1] != self._F or p1.shape[0] != n:
+            raise ValueError(
+                f"observe_batch shapes: X {X.shape}, p1 {p1.shape}, "
+                f"expected [n, {self._F}] / [n]"
+            )
+        if not np.isfinite(X).all():
+            # The monitored space is post-impute (finite) by contract; a
+            # NaN here would turn into a garbage int16 bin index. Raise
+            # loudly instead — the engine quarantines a failing feed.
+            raise ValueError("observe_batch rows must be finite")
+        fidx = _feature_bin_indices(X, self._mins, self._widths, self._B)
+        sidx = _score_bin_indices(p1, self._S)
+        if members is not None:
+            members = np.asarray(members, np.float64)
+            m = members.shape[1]
+            pair_sum = np.zeros(n)
+            for i in range(m):
+                for j in range(i + 1, m):
+                    pair_sum += np.abs(members[:, i] - members[:, j])
+            dis = pair_sum / max(m * (m - 1) / 2, 1)
+        else:
+            dis = np.full(n, np.nan)
+        n_observed = n  # the true row count — rows_total must not shrink
+        # when an oversize batch is truncated to the window below
+        if n > self.window:  # only the newest window rows can survive anyway
+            p1 = p1[-self.window:]
+            fidx, sidx, dis = (
+                fidx[-self.window:], sidx[-self.window:], dis[-self.window:]
+            )
+            n = self.window
+        with self._lock:
+            start = self._rows % self.window
+            take = min(n, self.window - start)
+            self._feat_ring[start:start + take] = fidx[:take]
+            self._score_ring[start:start + take] = sidx[:take]
+            self._score_val_ring[start:start + take] = p1[:take]
+            self._dis_ring[start:start + take] = dis[:take]
+            if take < n:  # wrap
+                rest = n - take
+                self._feat_ring[:rest] = fidx[take:]
+                self._score_ring[:rest] = sidx[take:]
+                self._score_val_ring[:rest] = p1[take:]
+                self._dis_ring[:rest] = dis[take:]
+            self._rows += n
+            self._rows_total += n_observed
+            due = self._rows - self._last_refresh_rows >= self.refresh_rows
+        self._c_rows.inc(n_observed)
+        self._g_window.get().set(float(min(self._rows, self.window)))
+        if due:
+            self._refresh()
+
+    # -- derive -------------------------------------------------------------
+
+    def _window_copy(self):
+        with self._lock:
+            n = min(self._rows, self.window)
+            return (
+                n,
+                self._feat_ring[:n].copy(),
+                self._score_ring[:n].copy(),
+                self._score_val_ring[:n].copy(),
+                self._dis_ring[:n].copy(),
+            )
+
+    def _refresh(self) -> None:
+        """Recompute drift statistics from the current window, update the
+        gauges, and journal a ``quality_status`` event when the status
+        crosses a threshold in either direction. Whole passes are
+        serialized (``_refresh_lock``) so a slower thread can never commit
+        a stale window's statistics over a fresher thread's."""
+        with self._refresh_lock:
+            self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
+        n, fidx, sidx, _svals, dis = self._window_copy()
+        with self._lock:
+            self._last_refresh_rows = self._rows
+        if n < self.min_rows:
+            return  # stats stay NaN/None until the window is meaningful
+        ref_fc = self._profile["bin_counts"]
+        f_psi = np.empty(self._F)
+        f_ks = np.empty(self._F)
+        for f in range(self._F):
+            counts = np.bincount(fidx[:, f], minlength=self._B)
+            f_psi[f] = psi(ref_fc[f], counts)
+            f_ks[f] = ks_binned(ref_fc[f], counts)
+        s_counts = np.bincount(sidx, minlength=self._S)
+        s_psi = psi(self._profile["score_counts"], s_counts)
+        have_dis = np.isfinite(dis)
+        disagreement = float(dis[have_dis].mean()) if have_dis.any() else float("nan")
+
+        worst_psi = max(float(f_psi.max()), s_psi)
+        new_status = (
+            "alert" if worst_psi >= self.alert_psi
+            else "warn" if worst_psi >= self.warn_psi
+            else "ok"
+        )
+        with self._lock:
+            self._feature_psi = f_psi
+            self._feature_ks = f_ks
+            self._score_psi = s_psi
+            self._disagreement = disagreement
+            old_status, self._status = self._status, new_status
+        for f, name in enumerate(self.feature_names):
+            self._g_feature_psi.set(float(f_psi[f]), feature=name)
+            self._g_feature_ks.set(float(f_ks[f]), feature=name)
+        self._g_score_psi.get().set(s_psi)
+        self._g_disagreement.get().set(disagreement)
+        self._g_status.get().set(float(_STATUS_LEVEL[new_status]))
+        if new_status != old_status:
+            worst_f, worst_f_psi = self._worst(f_psi, s_psi)
+            self._c_transitions.inc(to=new_status)
+            journal.event(
+                "quality_status",
+                from_status=old_status,
+                to_status=new_status,
+                worst_feature=worst_f,
+                worst_psi=_round(worst_f_psi),
+                score_psi=_round(s_psi),
+                window_rows=n,
+            )
+
+    def _worst_feature(self, f_psi: np.ndarray) -> tuple[str | None, float | None]:
+        if not np.isfinite(f_psi).any():
+            return None, None
+        i = int(np.nanargmax(f_psi))
+        return self.feature_names[i], float(f_psi[i])
+
+    def _worst(
+        self, f_psi: np.ndarray, s_psi: float
+    ) -> tuple[str | None, float | None]:
+        """Worst offender across features AND the score distribution (the
+        latter named by a ``__score__`` sentinel no contract variable can
+        collide with)."""
+        worst_f, worst_psi = self._worst_feature(f_psi)
+        if s_psi == s_psi and (worst_psi is None or s_psi > worst_psi):
+            return "__score__", float(s_psi)
+        return worst_f, worst_psi
+
+    def disable(self, reason: str) -> None:
+        """Mark the monitor dead (the engine quarantines a feed whose
+        ``observe_batch`` raised). A quarantined monitor must SAY so on
+        every surface — frozen statistics presented as live 'ok' are the
+        exact silent-monitoring-gap this module exists to close."""
+        with self._lock:
+            self._disabled_reason = reason
+        self._g_status.get().set(float("nan"))
+
+    # -- export -------------------------------------------------------------
+
+    @property
+    def n_features(self) -> int:
+        """Width of the monitored row space (the reference profile's F) —
+        callers validate it against what they will actually feed."""
+        return self._F
+
+    @property
+    def status(self) -> str:
+        with self._lock:
+            return self._status
+
+    def health(self) -> dict:
+        """The compact ``/healthz`` block: status + the single worst
+        offender, so an orchestrator can act on drift without scraping the
+        full ``/debug/quality`` payload."""
+        with self._lock:
+            if self._disabled_reason is not None:
+                return {"status": "disabled", "reason": self._disabled_reason}
+            status = self._status
+            f_psi = self._feature_psi
+            s_psi = self._score_psi
+        worst_f, worst_psi = self._worst(f_psi, s_psi)
+        return {
+            "status": status,
+            "worst_feature": worst_f,
+            "worst_psi": _round(worst_psi),
+        }
+
+    def snapshot(self, detail: bool = False) -> dict:
+        """The ``/debug/quality`` payload. Always strict-JSON-safe: every
+        not-yet-computable statistic is ``None``, never NaN."""
+        with self._lock:
+            disabled = self._disabled_reason
+        if disabled is not None:
+            return disabled_snapshot(disabled)
+        self._refresh()
+        n, fidx, sidx, svals, dis = self._window_copy()
+        with self._lock:
+            status = self._status
+            f_psi = self._feature_psi.copy()
+            f_ks = self._feature_ks.copy()
+            s_psi = self._score_psi
+            disagreement = self._disagreement
+            rows_total = self._rows_total
+        worst_f, worst_psi = self._worst(f_psi, s_psi)
+        out = {
+            "enabled": True,
+            "status": status,
+            "rows_total": rows_total,
+            "window_rows": n,
+            "min_rows": self.min_rows,
+            "thresholds": {
+                "warn_psi": self.warn_psi, "alert_psi": self.alert_psi,
+            },
+            "score_psi": _round(_null_if_nan(s_psi)),
+            "member_disagreement": _round(_null_if_nan(disagreement)),
+            "worst_feature": worst_f,
+            "worst_psi": _round(worst_psi),
+            "reference": {
+                "n_rows": int(self._profile["n_rows"]),
+                "feature_bins": self._B,
+                "score_bins": self._S,
+                "version": int(self._profile.get("version", 1)),
+            },
+        }
+        if not detail:
+            return out
+        ref_mean = self._profile.get("mean")
+        features = []
+        for f, name in enumerate(self.feature_names):
+            counts = np.bincount(fidx[:, f], minlength=self._B) if n else None
+            w_mean = None
+            if n:
+                # Window mean reconstructed from bin midpoints (the monitor
+                # stores indices, not values) — a diagnostic, not a statistic.
+                mids = 0.5 * (
+                    self._profile["bin_edges"][f, :-1]
+                    + self._profile["bin_edges"][f, 1:]
+                )
+                w_mean = float((mids * counts).sum() / counts.sum())
+            features.append({
+                "name": name,
+                "psi": _round(_null_if_nan(float(f_psi[f]))),
+                "ks": _round(_null_if_nan(float(f_ks[f]))),
+                "window_mean_binned": _round(w_mean),
+                "reference_mean": (
+                    _round(float(ref_mean[f])) if ref_mean is not None else None
+                ),
+            })
+        features.sort(key=lambda d: -1.0 if d["psi"] is None else d["psi"],
+                      reverse=True)
+        calib_count = np.bincount(sidx, minlength=self._S) if n else np.zeros(
+            self._S, np.int64
+        )
+        calib_mean = []
+        for b in range(self._S):
+            m = sidx == b if n else np.zeros(0, bool)
+            calib_mean.append(
+                _round(float(svals[m].mean())) if n and m.any() else None
+            )
+        out["features"] = features
+        out["calibration"] = {
+            "edges": [round(float(e), 6) for e in self._profile["score_edges"]],
+            "count": [int(c) for c in calib_count],
+            "mean_score": calib_mean,
+            "reference_pos_rate": [
+                _round(_null_if_nan(float(v)))
+                for v in self._profile.get(
+                    "calib_pos_rate", np.full(self._S, np.nan)
+                )
+            ],
+            "reference_count": [
+                int(c) for c in self._profile["score_counts"]
+            ],
+        }
+        return out
+
+
+def disabled_snapshot(reason: str) -> dict:
+    """The ``/debug/quality`` payload when no monitor is running."""
+    return {"enabled": False, "status": "disabled", "reason": reason}

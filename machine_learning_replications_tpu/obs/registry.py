@@ -276,7 +276,12 @@ class GaugeFamily(Family):
         lines.append(f"{self._series(label_values)} {_fmt_value(child.value)}")
 
     def _snap_child(self, child):
-        return child.value
+        # NaN is the text exposition's legal "no data" gauge value
+        # (obs.quality uses it before min_rows), but a bare NaN token is
+        # not strict JSON — snapshots are JSON payloads, so it becomes
+        # null there (the serving layer's established convention).
+        v = child.value
+        return None if v != v else v
 
 
 class HistogramFamily(Family):

@@ -186,7 +186,13 @@ def load_model(path: str | os.PathLike) -> Any:
     """Restore a checkpoint written by ``save_model`` using its JSON sidecar
     template (no code from the checkpoint directory ever runs). Arrays land
     on the default device; re-shard afterwards for mesh use
-    (``data.shard_rows`` / ``NamedSharding``)."""
+    (``data.shard_rows`` / ``NamedSharding``).
+
+    Full-pipeline checkpoints written before the quality reference profile
+    existed (their sidecar's ``PipelineParams`` node has no ``quality``
+    field) restore cleanly — the dataclass default fills ``None`` — with a
+    single journaled warning, so a serving process built on one says *why*
+    its drift monitoring is off instead of silently lacking it."""
     import json
 
     path = os.path.abspath(os.fspath(path))
@@ -194,7 +200,25 @@ def load_model(path: str | os.PathLike) -> Any:
         sidecar = json.load(f)
     if sidecar.get("format") != 1:
         raise ValueError(f"unknown sidecar format {sidecar.get('format')!r}")
-    return restore_params(path, _decode_template(sidecar["root"]))
+    root = sidecar["root"]
+    if root.get("cls") == "PipelineParams" and not _has_quality_profile(root):
+        from machine_learning_replications_tpu.obs import journal
+        from machine_learning_replications_tpu.utils.trace import stage_say
+
+        stage_say(
+            f"checkpoint {path!r} predates quality reference profiles — "
+            "drift monitoring will be disabled for models served from it"
+        )
+        journal.event("quality_profile_missing", path=path)
+    return restore_params(path, _decode_template(root))
+
+
+def _has_quality_profile(root: dict) -> bool:
+    """True when a sidecar ``PipelineParams`` node carries a non-null
+    reference profile (pre-profile checkpoints lack the field entirely;
+    a profile explicitly saved as None encodes as a static null)."""
+    q = root.get("fields", {}).get("quality")
+    return q is not None and q != {"static": None}
 
 
 class StageCheckpointer:

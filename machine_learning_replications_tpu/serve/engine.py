@@ -36,7 +36,7 @@ from typing import Sequence
 
 import numpy as np
 
-from machine_learning_replications_tpu.obs import jaxmon, spans
+from machine_learning_replications_tpu.obs import jaxmon, journal, spans
 
 DEFAULT_BUCKETS = (1, 8, 64, 512)
 
@@ -54,6 +54,7 @@ class BucketedPredictEngine:
         self,
         params,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
+        quality=None,
     ) -> None:
         import jax
 
@@ -69,6 +70,13 @@ class BucketedPredictEngine:
         self.trace_counts: dict[int, int] = {}
         self.warm = False
         self.n_features = 17  # the predict_hf.py:5-27 contract width
+        # obs.quality.QualityMonitor (or None): every predict() feeds it
+        # the batch's REAL rows in the model's input space — post-impute
+        # post-select for the pipeline route, the contract rows themselves
+        # for bare ensembles — plus blended and per-member probabilities.
+        # Warmup bypasses predict(), so synthetic warmup rows never touch
+        # the drift window.
+        self.quality = quality
 
         if not isinstance(
             params,
@@ -115,10 +123,13 @@ class BucketedPredictEngine:
             )
             # Full-pipeline route: host-orchestrated imputation feeding
             # the jitted stacked-probability core. One imputer compile +
-            # one core compile per bucket.
+            # one core compile per bucket. The core also returns the
+            # member meta-features: they are intermediates of the blended
+            # probability anyway, and the quality monitor's ensemble-
+            # agreement signal needs them per batch.
             def core(ens, X17sel):
                 self._note_trace(int(X17sel.shape[0]))
-                return stacking.predict_proba1(ens, X17sel)
+                return stacking.predict_proba1_with_members(ens, X17sel)
 
             jit_core = jax.jit(core)
 
@@ -129,29 +140,36 @@ class BucketedPredictEngine:
                 # the pattern past the pre-resolved fn: fall back to
                 # per-call resolution rather than serve an unimputed NaN.
                 fn = None if np.isnan(X17).any() else contract_block_fn
-                return jit_core(
-                    dparams.ensemble,
-                    pipeline.impute_select(dparams, x64, block_fn=fn),
-                )
+                X17sel = pipeline.impute_select(dparams, x64, block_fn=fn)
+                p1, members = jit_core(dparams.ensemble, X17sel)
+                # The quality rows are the POST-impute post-select matrix —
+                # the space the reference profile was built over.
+                return p1, members, X17sel
 
-        else:
-            # tree.TreeEnsembleParams / stacking.StackingParams: rows are
-            # already the member ensemble's 17-column input — one jitted
-            # call, differing only in the predict function.
-            fn = (
-                tree.predict_proba1
-                if isinstance(params, tree.TreeEnsembleParams)
-                else stacking.predict_proba1
-            )
-
+        elif isinstance(params, tree.TreeEnsembleParams):
+            # Bare GBDT (`sweep --save`): one jitted call, no member
+            # outputs to disagree over.
             def core(p, X):
                 self._note_trace(int(X.shape[0]))
-                return fn(p, X)
+                return tree.predict_proba1(p, X)
 
             jit_core = jax.jit(core)
 
             def impl(X):
-                return jit_core(dparams, X)
+                return jit_core(dparams, X), None, X
+
+        else:
+            # stacking.StackingParams: rows are already the member
+            # ensemble's 17-column input.
+            def core(p, X):
+                self._note_trace(int(X.shape[0]))
+                return stacking.predict_proba1_with_members(p, X)
+
+            jit_core = jax.jit(core)
+
+            def impl(X):
+                p1, members = jit_core(dparams, X)
+                return p1, members, X
 
         self._impl = impl
 
@@ -196,7 +214,35 @@ class BucketedPredictEngine:
         b = self.bucket_for(n)
         if n < b:
             X = np.pad(X, ((0, b - n), (0, 0)), mode="edge")
-        return np.asarray(self._impl(X))[:n]
+        p1, members, qrows = self._impl(X)
+        probs = np.asarray(p1, np.float64)[:n]
+        if self.quality is not None:
+            try:
+                # Pad rows sliced off BEFORE the monitor sees anything:
+                # edge-replicated rows would double-weight the last real
+                # patient.
+                self.quality.observe_batch(
+                    np.asarray(qrows)[:n],
+                    probs,
+                    None if members is None
+                    else np.asarray(members, np.float64)[:n],
+                )
+            except Exception as exc:
+                # Telemetry must never take serving down: the prediction
+                # already succeeded, so a monitor failure (mis-sized
+                # profile, NaN rows from a direct predict() caller)
+                # quarantines the feed — journaled once — instead of
+                # failing every batch forever. disable() makes the
+                # quarantine visible on /healthz and /debug/quality, which
+                # keep their reference to the monitor; frozen stats
+                # presented as live 'ok' would be a silent monitoring gap.
+                msg = f"{type(exc).__name__}: {exc}"
+                journal.event("quality_feed_disabled", error=msg)
+                disable = getattr(self.quality, "disable", None)
+                if disable is not None:
+                    disable(f"feed quarantined: {msg}")
+                self.quality = None
+        return probs
 
     def warmup(self, say=None) -> dict[int, float]:
         """Compile every ladder bucket up front (example-patient rows, each

@@ -20,8 +20,11 @@ shared micro-batcher, no third-party dependencies):
                   respond.
   GET  /healthz   liveness/readiness *and* load signal for an external
                   prober: params family, bucket ladder, warm flag, queue
-                  depth, uptime, and the run id from the journal manifest
-                  when one is active.
+                  depth, uptime, the run id from the journal manifest
+                  when one is active, and a compact model-quality block
+                  (``{"status": ok|warn|alert|disabled, "worst_feature",
+                  "worst_psi"}``) so an orchestrator can act on drift
+                  without scraping ``/debug/quality``.
   GET  /metrics   Prometheus text exposition (``?format=json`` for the
                   same data as JSON) — ``serve.metrics``, with the
                   process-global ``obs`` registry's exposition appended
@@ -41,6 +44,14 @@ shared micro-batcher, no third-party dependencies):
                   (default 1) while traffic keeps flowing; replies with
                   the artifact file list. Single-flight: a capture in
                   progress makes concurrent calls fail fast with 409.
+  GET  /debug/quality
+                  the model-quality monitor's full snapshot
+                  (``obs.quality``): drift status vs the training
+                  reference profile, per-feature PSI/KS sorted worst
+                  first, score-distribution PSI, calibration bins, and
+                  windowed ensemble disagreement. ``{"enabled": false}``
+                  when the served params carry no reference profile or
+                  the server started with ``--no-quality``.
 
 ``ServerHandle.shutdown`` is the graceful path: stop accepting, drain the
 batcher (admitted requests are never dropped), then stop the listener.
@@ -73,6 +84,7 @@ from machine_learning_replications_tpu.obs import (
     reqtrace,
     slo,
 )
+from machine_learning_replications_tpu.obs import quality as qualitymod
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.serve.batcher import (
     MicroBatcher,
@@ -97,6 +109,7 @@ class ServerHandle:
     def __init__(
         self, engine, batcher, metrics, httpd,
         recorder=None, slo_tracker=None, profile_dir: str | None = None,
+        quality=None,
     ) -> None:
         self.engine = engine
         self.batcher = batcher
@@ -105,6 +118,7 @@ class ServerHandle:
         self.recorder = recorder
         self.slo_tracker = slo_tracker
         self.profile_dir = profile_dir
+        self.quality = quality  # obs.quality.QualityMonitor or None
         self._thread: threading.Thread | None = None
 
     @property
@@ -188,7 +202,23 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                         jrn.manifest.get("run_id") if jrn is not None
                         else None
                     ),
+                    # Compact drift signal so an orchestrator can act on
+                    # model-quality degradation from the same probe it
+                    # already polls (full detail: /debug/quality).
+                    "quality": (
+                        handle.quality.health()
+                        if handle.quality is not None
+                        else {"status": "disabled"}
+                    ),
                 })
+            elif url.path == "/debug/quality":
+                if handle.quality is None:
+                    self._json(200, qualitymod.disabled_snapshot(
+                        "no reference profile in the served params "
+                        "(or started with --no-quality)"
+                    ))
+                else:
+                    self._json(200, handle.quality.snapshot(detail=True))
             elif url.path == "/debug/requests":
                 try:
                     n = int(parse_qs(url.query).get("n", ["64"])[0])
@@ -406,6 +436,11 @@ def make_server(
     trace_capacity: int = 256,
     tail_quantile: float = 0.99,
     profile_dir: str | None = None,
+    quality_profile=None,
+    no_quality: bool = False,
+    drift_warn_psi: float = qualitymod.DEFAULT_WARN_PSI,
+    drift_alert_psi: float = qualitymod.DEFAULT_ALERT_PSI,
+    quality_window: int = 2048,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
@@ -420,6 +455,18 @@ def make_server(
     (default a per-process dir under the system temp dir) receives
     ``/debug/profile`` captures.
 
+    Model-quality monitoring (``obs.quality``): ``quality_profile`` is the
+    training-time reference profile — by default the one the served
+    ``PipelineParams`` carries (``params.quality``); pass one explicitly to
+    monitor a bare imported ensemble, or ``no_quality=True`` to disable.
+    When a profile is available, every flushed batch streams into a
+    ``QualityMonitor`` (PSI/KS drift vs the reference under the
+    ``drift_warn_psi``/``drift_alert_psi`` thresholds, over a
+    ``quality_window``-row sliding window) exported on ``/metrics``
+    (``quality_*``), ``/debug/quality``, and ``/healthz``. Without one,
+    quality monitoring is simply off (``/healthz`` says ``disabled``) —
+    pre-profile checkpoints keep serving.
+
     The listener BINDS before warmup runs: a port conflict fails in
     milliseconds instead of after the multi-second compile bill. Warmup
     still completes before this returns (warm standby — the first served
@@ -428,7 +475,62 @@ def make_server(
     # Compile/transfer accounting BEFORE the engine exists, so the param
     # upload and every warmup compile land in the /metrics counters.
     jaxmon.install()
-    engine = BucketedPredictEngine(params, buckets=buckets)
+    quality_monitor = None
+    if not no_quality:
+        prof = (
+            quality_profile if quality_profile is not None
+            else getattr(params, "quality", None)
+        )
+        if prof is not None:
+            import numpy as np
+
+            # Full-pipeline checkpoints profile the model's OWN
+            # lasso-selected columns (ascending schema order) — NOT the
+            # 17-variable contract order a bare ensemble scores — so the
+            # monitor's feature labels must come from the support mask,
+            # or every quality_feature_psi series (and the /debug/quality
+            # worst-offender table) names the wrong variable.
+            feature_names = None
+            support_mask = getattr(params, "support_mask", None)
+            if support_mask is not None:
+                from machine_learning_replications_tpu.data.schema import (
+                    variable_names,
+                )
+
+                names = variable_names()
+                feature_names = [
+                    names[i] for i in np.where(np.asarray(support_mask))[0]
+                ]
+            # Fail at startup, not on the first flush: a profile whose
+            # width doesn't match the rows the engine will feed (e.g. one
+            # built over a pre-selection 64-column matrix attached to a
+            # bare 17-column ensemble) would otherwise fail every served
+            # batch's observe call. Checked on the RAW profile, before
+            # the monitor exists — constructing it first would register
+            # phantom series in the process-global registry that no
+            # rejection can remove.
+            expected_width = (
+                len(feature_names) if feature_names is not None else 17
+            )
+            if isinstance(prof, dict) and "bin_counts" in prof:
+                width = int(np.asarray(prof["bin_counts"]).shape[0])
+                if width != expected_width:
+                    raise ValueError(
+                        f"quality profile is {width} features wide but "
+                        f"the served model scores {expected_width}-feature "
+                        "rows; build the profile over the model's own "
+                        "input space"
+                    )
+            quality_monitor = qualitymod.QualityMonitor(
+                prof,
+                warn_psi=drift_warn_psi,
+                alert_psi=drift_alert_psi,
+                window=quality_window,
+                feature_names=feature_names,
+            )
+    engine = BucketedPredictEngine(
+        params, buckets=buckets, quality=quality_monitor
+    )
     metrics = ServingMetrics(batch_buckets=engine.buckets)
     batcher = MicroBatcher(
         engine,
@@ -451,6 +553,7 @@ def make_server(
     handle = ServerHandle(
         engine, batcher, metrics, None,
         recorder=recorder, slo_tracker=slo_tracker, profile_dir=profile_dir,
+        quality=quality_monitor,
     )
     handler = _make_handler(handle, request_timeout_s, quiet)
     try:

@@ -281,3 +281,120 @@ def test_cv_substage_resume_equals_unbroken(tmp_path, cohort):
         jax.tree.leaves(resumed), jax.tree.leaves(unbroken)
     ):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# quality reference profile: carried by the checkpoint, absent in old dirs
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fitted_small_pipeline(cohort):
+    """One small-but-real fit_pipeline shared by the profile tests."""
+    from machine_learning_replications_tpu.config import (
+        ExperimentConfig, GBDTConfig, LassoSelectConfig, SVCConfig,
+    )
+    from machine_learning_replications_tpu.models import pipeline
+
+    X, y, _ = cohort
+    X, y = np.asarray(X[:150]), np.asarray(y[:150])
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=4),
+        svc=SVCConfig(platt_cv=2, max_iter=150),
+        select=LassoSelectConfig(cv_folds=3, n_alphas=10),
+    )
+    params, _ = pipeline.fit_pipeline(X, y, cfg)
+    return params
+
+
+def test_fit_pipeline_builds_quality_profile_and_roundtrips(
+    tmp_path, fitted_small_pipeline
+):
+    """Tentpole contract: fit_pipeline records the model's own drift
+    baseline — per-feature histograms over the post-impute post-select
+    X[n, 17] plus the training score distribution — and the checkpoint
+    carries it bit-for-bit through save_model/load_model (the sidecar's
+    plain mapping node, no new registry class)."""
+    from machine_learning_replications_tpu.obs import quality
+
+    params = fitted_small_pipeline
+    prof = {k: np.asarray(v) for k, v in params.quality.items()}
+    F = int(np.asarray(params.support_mask).sum())
+    B = quality.DEFAULT_FEATURE_BINS
+    assert prof["bin_edges"].shape == (F, B + 1)
+    assert prof["bin_counts"].shape == (F, B)
+    assert int(prof["n_rows"]) == 150
+    assert prof["score_counts"].sum() == 150
+    assert np.isfinite(prof["calib_pos_rate"]).any()  # labels were present
+    path = str(tmp_path / "with_profile")
+    orbax_io.save_model(path, params)
+    restored = orbax_io.load_model(path)
+    for k, v in prof.items():
+        np.testing.assert_array_equal(np.asarray(restored.quality[k]), v)
+    # and a monitor constructs straight from the restored profile (the
+    # serve-time key/shape contract)
+    from machine_learning_replications_tpu.obs.registry import (
+        MetricsRegistry,
+    )
+
+    quality.QualityMonitor(restored.quality, registry=MetricsRegistry())
+
+
+def test_profile_less_checkpoint_loads_with_single_journaled_warning(
+    tmp_path, fitted_small_pipeline
+):
+    """Backward compat: a checkpoint dir written BEFORE reference profiles
+    existed (its sidecar's PipelineParams node has no 'quality' field at
+    all) must restore cleanly — quality None, monitoring simply disabled —
+    with exactly one journaled warning naming the gap."""
+    import json as _json
+
+    from machine_learning_replications_tpu.obs import journal
+
+    params = fitted_small_pipeline
+    path = str(tmp_path / "old_format")
+    # Saving with quality=None writes the same Orbax array tree an old
+    # build wrote (None leaves are absent from the pytree); stripping the
+    # sidecar field reproduces the old sidecar byte-structure exactly.
+    orbax_io.save_model(path, params.replace(quality=None))
+    sc_path = tmp_path / "old_format" / "pytree_template.json"
+    sidecar = _json.loads(sc_path.read_text())
+    assert sidecar["root"]["fields"]["quality"] == {"static": None}
+    del sidecar["root"]["fields"]["quality"]
+    sc_path.write_text(_json.dumps(sidecar))
+
+    jrn = journal.RunJournal(tmp_path / "restore.jsonl", command="predict")
+    journal.set_journal(jrn)
+    try:
+        restored = orbax_io.load_model(path)
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+    assert restored.quality is None
+    assert np.asarray(restored.ensemble.meta.coef).shape == np.asarray(
+        params.ensemble.meta.coef
+    ).shape
+    events = [
+        _json.loads(line) for line in open(tmp_path / "restore.jsonl")
+    ]
+    warnings_ = [
+        e for e in events if e.get("kind") == "quality_profile_missing"
+    ]
+    assert len(warnings_) == 1
+    assert warnings_[0]["path"] == orbax_io.os.path.abspath(path)
+    # a checkpoint WITH a profile journals nothing
+    path2 = str(tmp_path / "new_format")
+    orbax_io.save_model(path2, params)
+    jrn2 = journal.RunJournal(tmp_path / "restore2.jsonl", command="predict")
+    journal.set_journal(jrn2)
+    try:
+        orbax_io.load_model(path2)
+    finally:
+        journal.set_journal(None)
+        jrn2.close()
+    events2 = [
+        _json.loads(line) for line in open(tmp_path / "restore2.jsonl")
+    ]
+    assert not [
+        e for e in events2 if e.get("kind") == "quality_profile_missing"
+    ]

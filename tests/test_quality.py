@@ -1,0 +1,369 @@
+"""Model-quality observability (obs.quality): drift math on analytic
+distributions, streaming monitor semantics, journal transitions, and
+exposition validity of the quality_* families.
+
+The golden tests pin the PSI/KS implementations to values computable by
+hand: identical distributions must sit at ~0, a shifted normal must match
+the analytic PSI derived from normal CDF bin masses over the profile's
+own edges, and a shifted uniform must produce its textbook KS distance.
+Low-count windows must say ``None`` (strict JSON), never NaN — the PR 1
+metrics convention.
+"""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.obs import journal, quality
+from machine_learning_replications_tpu.obs.registry import MetricsRegistry
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+try:
+    import validate_metrics
+finally:
+    sys.path.pop(0)
+
+
+def _norm_cdf(x, mu=0.0, sigma=1.0):
+    return 0.5 * (1.0 + math.erf((x - mu) / (sigma * math.sqrt(2.0))))
+
+
+def _profile(X, scores=None, y=None, **kw):
+    if scores is None:
+        scores = np.full(X.shape[0], 0.5)
+    return quality.build_reference_profile(X, scores, y=y, **kw)
+
+
+# ---------------------------------------------------------------------------
+# drift math: golden values on analytic distributions
+# ---------------------------------------------------------------------------
+
+
+def test_psi_identical_distribution_is_near_zero():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=100_000)
+    b = rng.normal(size=100_000)
+    edges = np.linspace(-4, 4, 11)
+    ca, _ = np.histogram(np.clip(a, -4, 4), edges)
+    cb, _ = np.histogram(np.clip(b, -4, 4), edges)
+    assert quality.psi(ca, cb) == pytest.approx(0.0, abs=5e-3)
+    assert quality.psi(ca, ca) == 0.0
+    assert quality.ks_binned(ca, ca) == 0.0
+
+
+def test_psi_shifted_normal_matches_analytic_value():
+    """PSI of N(0.5, 1) traffic against an N(0, 1) reference, on the
+    reference profile's own equal-width edges, must match the value
+    computed independently from normal CDF bin masses."""
+    rng = np.random.default_rng(1)
+    n = 200_000
+    ref = rng.normal(size=n)
+    shifted = rng.normal(loc=0.5, size=n)
+    prof = _profile(ref[:, None])
+    edges = prof["bin_edges"][0]
+    # Analytic bin masses with the edge bins open (the monitor clips
+    # out-of-range values into them), floored at the PSI eps exactly as
+    # the implementation floors empirical proportions.
+    eps = 1e-4
+
+    def masses(mu):
+        cdf = [0.0] + [_norm_cdf(e, mu) for e in edges[1:-1]] + [1.0]
+        return np.maximum(np.diff(cdf), eps)
+
+    p_e, p_a = masses(0.0), masses(0.5)
+    expected = float(np.sum((p_a - p_e) * np.log(p_a / p_e)))
+    mins = prof["bin_edges"][:, 0]
+    widths = prof["bin_edges"][:, -1] - mins
+    counts = np.bincount(
+        quality._feature_bin_indices(
+            shifted[:, None], mins, widths, prof["bin_counts"].shape[1]
+        )[:, 0],
+        minlength=prof["bin_counts"].shape[1],
+    )
+    got = quality.psi(prof["bin_counts"][0], counts)
+    assert got == pytest.approx(expected, rel=0.05)
+    assert got > quality.DEFAULT_WARN_PSI  # a half-sigma shift must warn
+
+
+def test_ks_binned_shifted_uniform_golden():
+    """U(0, 1) reference vs U(0.25, 1.25) traffic: the exact KS distance
+    is 0.25, and with traffic clipped into the reference's [0, 1] bins
+    the binned estimate must land there too."""
+    rng = np.random.default_rng(2)
+    n = 200_000
+    ref = rng.uniform(0, 1, size=n)
+    traffic = rng.uniform(0.25, 1.25, size=n)
+    edges = np.linspace(0, 1, 11)
+    c_ref, _ = np.histogram(ref, edges)
+    c_tr, _ = np.histogram(np.clip(traffic, 0, 1), edges)
+    assert quality.ks_binned(c_ref, c_tr) == pytest.approx(0.25, abs=0.01)
+
+
+def test_psi_ks_reject_malformed_histograms():
+    with pytest.raises(ValueError, match="shapes"):
+        quality.psi([1, 2, 3], [1, 2])
+    with pytest.raises(ValueError, match="non-empty"):
+        quality.psi([0, 0], [1, 2])
+    with pytest.raises(ValueError, match="shapes"):
+        quality.ks_binned([1, 2, 3], [1, 2])
+    with pytest.raises(ValueError, match="non-empty"):
+        quality.ks_binned([1, 2], [0, 0])
+
+
+# ---------------------------------------------------------------------------
+# reference profile
+# ---------------------------------------------------------------------------
+
+
+def test_reference_profile_shapes_and_contents():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(500, 17))
+    scores = rng.uniform(0, 1, size=500)
+    y = (scores > 0.5).astype(float)
+    prof = quality.build_reference_profile(X, scores, y)
+    F, B = 17, quality.DEFAULT_FEATURE_BINS
+    S = quality.DEFAULT_SCORE_BINS
+    assert prof["bin_edges"].shape == (F, B + 1)
+    assert prof["bin_counts"].shape == (F, B)
+    np.testing.assert_array_equal(prof["bin_counts"].sum(axis=1), 500)
+    np.testing.assert_allclose(prof["mean"], X.mean(axis=0))
+    assert prof["score_counts"].shape == (S,)
+    assert prof["score_counts"].sum() == 500
+    # calibration: every populated score bin's training pos rate is the
+    # label mean of the scores that landed there
+    sidx = np.clip((scores * S).astype(int), 0, S - 1)
+    for b in range(S):
+        m = sidx == b
+        if m.any():
+            assert prof["calib_pos_rate"][b] == pytest.approx(y[m].mean())
+    # every value is an ndarray — the contract that lets the Orbax sidecar
+    # carry the profile as a plain mapping node
+    assert all(isinstance(v, np.ndarray) for v in prof.values())
+
+
+def test_reference_profile_rejects_bad_input():
+    with pytest.raises(ValueError, match="finite"):
+        quality.build_reference_profile(
+            np.array([[1.0, np.nan]]), np.array([0.5])
+        )
+    with pytest.raises(ValueError, match="scores length"):
+        quality.build_reference_profile(
+            np.ones((3, 2)), np.array([0.5])
+        )
+    with pytest.raises(ValueError, match="non-empty"):
+        quality.build_reference_profile(
+            np.ones((0, 2)), np.zeros(0)
+        )
+
+
+def test_constant_feature_is_degenerate_but_finite():
+    X = np.ones((100, 2))
+    X[:, 1] = np.linspace(0, 1, 100)
+    prof = _profile(X)
+    m = quality.QualityMonitor(prof, registry=MetricsRegistry(), min_rows=10,
+                               feature_names=("const", "ramp"))
+    m.observe_batch(X, np.full(100, 0.5))
+    snap = m.snapshot(detail=True)
+    by_name = {f["name"]: f for f in snap["features"]}
+    assert by_name["const"]["psi"] == pytest.approx(0.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# streaming monitor
+# ---------------------------------------------------------------------------
+
+
+def _stable_monitor(n_ref=4000, window=1024, **kw):
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(n_ref, 17))
+    scores = 1.0 / (1.0 + np.exp(-X @ rng.normal(size=17) / 4.0))
+    prof = quality.build_reference_profile(X, scores, (scores > 0.5).astype(float))
+    mon = quality.QualityMonitor(
+        prof, window=window, registry=MetricsRegistry(), **kw
+    )
+    return mon, X, scores, rng
+
+
+def test_low_count_window_is_null_not_nan():
+    mon, X, scores, _ = _stable_monitor(min_rows=50)
+    mon.observe_batch(X[:10], scores[:10])
+    snap = mon.snapshot(detail=True)
+    # strict JSON: the whole payload must serialize with allow_nan=False
+    json.dumps(snap, allow_nan=False)
+    assert snap["status"] == "ok"
+    assert snap["score_psi"] is None
+    assert snap["worst_psi"] is None
+    assert all(f["psi"] is None and f["ks"] is None for f in snap["features"])
+    assert snap["window_rows"] == 10
+    assert mon.health() == {
+        "status": "ok", "worst_feature": None, "worst_psi": None,
+    }
+
+
+def test_stable_traffic_stays_ok_and_shift_alerts_with_journal(tmp_path):
+    mon, X, scores, rng = _stable_monitor(min_rows=100)
+    jrn = journal.RunJournal(tmp_path / "j.jsonl", command="test")
+    journal.set_journal(jrn)
+    try:
+        # fresh draws from the SAME distributions: status must stay ok
+        # (scores resampled from the reference's own empirical scores —
+        # the stable-score leg; the feature legs are fresh normal draws)
+        X2 = rng.normal(size=(800, 17))
+        mon.observe_batch(X2, rng.choice(scores, size=800))
+        assert mon.status == "ok"
+        snap = mon.snapshot()
+        assert snap["status"] == "ok"
+        assert snap["score_psi"] < quality.DEFAULT_WARN_PSI
+        # a 3-sigma shift on one feature must alert, and the transition
+        # must be journaled with the offender named
+        X3 = X2.copy()
+        X3[:, 16] += 3.0
+        mon.observe_batch(X3, rng.choice(scores, size=800))
+        assert mon.status == "alert"
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+    events = [
+        json.loads(line) for line in open(tmp_path / "j.jsonl")
+    ]
+    trans = [e for e in events if e.get("kind") == "quality_status"]
+    assert [
+        (e["from_status"], e["to_status"]) for e in trans
+    ] == [("ok", "alert")]
+    assert trans[0]["worst_feature"] == "Ejection_Fraction"
+    assert trans[0]["worst_psi"] > quality.DEFAULT_ALERT_PSI
+
+
+def test_window_slides_and_recovers():
+    """The ring forgets: after a drift burst, enough clean traffic must
+    bring the status back to ok (and journal the recovery transition)."""
+    mon, X, scores, rng = _stable_monitor(window=512, min_rows=100)
+    bad = X[:512].copy()
+    bad[:, 0] += 5.0
+    mon.observe_batch(bad, rng.choice(scores, size=512))
+    assert mon.status == "alert"
+    mon.observe_batch(
+        rng.normal(size=(512, 17)), rng.choice(scores, size=512)
+    )
+    assert mon.status == "ok"
+    assert mon.snapshot()["window_rows"] == 512
+
+
+def test_member_disagreement_windowed_mean():
+    mon, X, scores, _ = _stable_monitor(min_rows=10)
+    n = 100
+    p = np.full(n, 0.5)
+    # members at p, p+0.1, p+0.2: pairwise |diffs| = .1, .2, .1 → mean 2/15
+    members = np.stack([p, p + 0.1, p + 0.2], axis=1)
+    mon.observe_batch(X[:n], p, members)
+    snap = mon.snapshot()
+    # snapshot rounds to 6 decimals for payload compactness
+    assert snap["member_disagreement"] == pytest.approx(2.0 / 15.0, abs=1e-6)
+    # no members (e.g. a bare GBDT) → null, not NaN
+    mon2, X2, s2, _ = _stable_monitor(min_rows=10)
+    mon2.observe_batch(X2[:n], p)
+    assert mon2.snapshot()["member_disagreement"] is None
+
+
+def test_oversized_batch_keeps_newest_window_rows():
+    mon, X, scores, rng = _stable_monitor(window=256, min_rows=10)
+    big = np.concatenate([X[:300], X[:300] + 9.0])  # old clean, new shifted
+    mon.observe_batch(big, np.concatenate([scores[:300]] * 2))
+    snap = mon.snapshot()
+    assert snap["window_rows"] == 256
+    assert snap["rows_total"] == 600  # truncation must not shrink the count
+    assert snap["status"] == "alert"  # only the (shifted) tail survived
+
+
+def test_monitor_validates_construction():
+    mon, X, scores, _ = _stable_monitor()
+    prof = mon._profile
+    with pytest.raises(ValueError, match="warn_psi"):
+        quality.QualityMonitor(prof, warn_psi=0.5, alert_psi=0.25,
+                               registry=MetricsRegistry())
+    with pytest.raises(ValueError, match=">= 1"):
+        quality.QualityMonitor(prof, window=0, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="never be computed"):
+        # a window that can never reach min_rows would silently pin the
+        # status at ok forever — refused at construction
+        quality.QualityMonitor(prof, window=128, min_rows=200,
+                               registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="feature names"):
+        quality.QualityMonitor(prof, feature_names=("just_one",),
+                               registry=MetricsRegistry())
+    with pytest.raises(TypeError, match="dict"):
+        quality.QualityMonitor(object(), registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="missing keys"):
+        quality.QualityMonitor({"bin_edges": np.zeros((2, 3))},
+                               registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="version"):
+        bad = dict(prof)
+        bad["version"] = np.asarray(quality.PROFILE_VERSION + 1)
+        quality.QualityMonitor(bad, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="shapes"):
+        mon.observe_batch(np.ones((3, 5)), np.ones(3))
+    with pytest.raises(ValueError, match="finite"):
+        bad_rows = np.ones((3, 17))
+        bad_rows[1, 4] = np.nan
+        mon.observe_batch(bad_rows, np.ones(3))
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+
+def test_quality_families_are_exposition_valid_before_and_after_traffic():
+    """The quality_* families must render a strict-validator-clean page in
+    every monitor state: freshly constructed (drift gauges NaN = no data),
+    below min_rows, and after a full refresh."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(1000, 17))
+    scores = rng.uniform(0, 1, 1000)
+    reg = MetricsRegistry()
+    mon = quality.QualityMonitor(
+        _profile(X, scores), registry=reg, min_rows=50
+    )
+    page = reg.render_prometheus()
+    assert validate_metrics.validate(page) == []
+    # the JSON snapshot of the same registry must be strict JSON even
+    # while the drift gauges hold their NaN "no data" value (they become
+    # null — the /metrics?format=json page embeds this snapshot)
+    json.dumps(reg.snapshot(), allow_nan=False)
+    assert reg.snapshot()["quality_score_psi"] is None
+    for name in (
+        "quality_feature_psi", "quality_feature_ks", "quality_score_psi",
+        "quality_member_disagreement", "quality_window_rows",
+        "quality_status", "quality_rows_total",
+        "quality_status_transitions_total",
+    ):
+        assert name in page, f"{name} missing from first scrape"
+    mon.observe_batch(X[:10], scores[:10])
+    assert validate_metrics.validate(reg.render_prometheus()) == []
+    mon.observe_batch(X, scores)
+    page = reg.render_prometheus()
+    assert validate_metrics.validate(page) == []
+    # after refresh the gauges carry real (finite) values
+    for line in page.splitlines():
+        if line.startswith("quality_score_psi "):
+            assert float(line.split()[-1]) < quality.DEFAULT_WARN_PSI
+
+
+def test_status_gauge_and_transition_counter_track_status():
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(1000, 17))
+    scores = rng.uniform(0, 1, 1000)
+    reg = MetricsRegistry()
+    mon = quality.QualityMonitor(
+        _profile(X, scores), registry=reg, min_rows=50, window=512
+    )
+    mon.observe_batch(X[:512] + 7.0, scores[:512])
+    snap = reg.snapshot()
+    assert snap["quality_status"] == 2.0  # alert
+    assert snap["quality_status_transitions_total"]["to=alert"] == 1
+    assert snap["quality_rows_total"] == 512
+    assert snap["quality_window_rows"] == 512.0

@@ -1054,7 +1054,7 @@ def test_obs_report_joins_all_sources(served, tmp_path):
     report = report_path.read_text()
     for section in (
         "# Observability report", "## Run", "## Traffic",
-        "## Runtime (XLA accounting)", "## SLO",
+        "## Runtime (XLA accounting)", "## SLO", "## Model quality",
         "## Tail-sampled requests", "## Journal digest",
         "## Bench join",
     ):
@@ -1083,3 +1083,307 @@ def test_shipped_pickle_served_equals_cli(capsys):
     prob = float(eng.predict(patient_row())[0])
     assert OUTPUT_CONTRACT.format(100.0 * prob) == cli_line
     assert "27.09" in cli_line  # SURVEY.md §2.3 pinned example output
+
+
+# ---------------------------------------------------------------------------
+# model-quality monitoring (obs.quality) through the serving stack
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def quality_cohort(stacking_params):
+    """The 17-column cohort the module's sklearn fixture trained on, plus
+    a matching reference profile — training rows scored through the
+    SERVED ensemble, exactly what ``fit_pipeline`` records, so the score
+    distribution baseline matches what serving will produce."""
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.obs import quality
+
+    rng = np.random.default_rng(7)
+    n, f = 300, 17
+    X = rng.normal(size=(n, f))
+    X[:, :10] = (X[:, :10] > 0.3).astype(float)
+    y = (X @ rng.normal(size=f) + rng.normal(size=n) > 0.2).astype(float)
+    scores = np.asarray(stacking.predict_proba1(stacking_params, X))
+    profile = quality.build_reference_profile(X, scores, y)
+    return X, profile
+
+
+def _patient_of(row):
+    from machine_learning_replications_tpu.data.schema import SELECTED_17
+
+    return {k: float(v) for k, v in zip(SELECTED_17, row)}
+
+
+def test_engine_feeds_quality_only_real_rows(stacking_params, quality_cohort):
+    """The engine's quality feed: warmup rows never touch the monitor, pad
+    rows are sliced off before it, chunked oversize batches count once per
+    real row, and member outputs flow through for disagreement."""
+    from machine_learning_replications_tpu.obs import quality
+    from machine_learning_replications_tpu.obs.registry import MetricsRegistry
+
+    X, profile = quality_cohort
+    mon = quality.QualityMonitor(
+        profile, registry=MetricsRegistry(), min_rows=10, window=256
+    )
+    eng = BucketedPredictEngine(
+        stacking_params, buckets=(1, 8), quality=mon
+    )
+    eng.warmup()
+    assert mon.snapshot()["rows_total"] == 0  # warmup bypasses the window
+    eng.predict(X[:3])  # pads to bucket 8; only 3 real rows may count
+    assert mon.snapshot()["rows_total"] == 3
+    eng.predict(X[:20])  # beyond the top bucket: chunked, still 20 rows
+    snap = mon.snapshot()
+    assert snap["rows_total"] == 23
+    assert snap["member_disagreement"] is not None  # members flowed through
+
+
+def test_quality_disabled_without_profile(served):
+    """A served bare ensemble with no profile attached: /healthz says
+    disabled, /debug/quality explains itself, and both stay strict JSON."""
+    _, url = served
+    _, body = _get(url + "/healthz")
+    assert json.loads(body)["quality"] == {"status": "disabled"}
+    _, body = _get(url + "/debug/quality")
+    q = json.loads(body)
+    assert q["enabled"] is False and "reason" in q
+
+
+def test_served_quality_ok_then_alert_on_perturbed_traffic(
+    stacking_params, quality_cohort, tmp_path
+):
+    """The E2E drift loop: cohort-distributed traffic keeps status ok;
+    perturbing two variables flips it to alert with those variables as
+    the top PSI offenders, the transition journaled, /healthz carrying
+    the compact block, and the quality_* families validator-clean on
+    /metrics."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import validate_metrics
+    finally:
+        sys.path.pop(0)
+
+    from machine_learning_replications_tpu.obs import journal
+
+    X, profile = quality_cohort
+    jrn = journal.RunJournal(tmp_path / "quality.jsonl", command="serve")
+    journal.set_journal(jrn)
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), max_wait_ms=2.0,
+        quality_profile=profile, quality_window=512,
+    ).start_background()
+    try:
+        host, port = handle.address
+        url = f"http://{host}:{port}"
+        for i in range(240):
+            status, _ = _post(url + "/predict", _patient_of(X[i % len(X)]))
+            assert status == 200
+        _, body = _get(url + "/debug/quality")
+        q = json.loads(body)
+        assert q["enabled"] is True and q["status"] == "ok"
+        assert q["rows_total"] == 240
+        assert q["score_psi"] is not None
+        _, body = _get(url + "/healthz")
+        assert json.loads(body)["quality"]["status"] == "ok"
+
+        # upstream unit bug: wall thickness 10x, EF halved
+        for i in range(240):
+            p = _patient_of(X[i % len(X)])
+            p["Max_Wall_Thick"] *= 10.0
+            p["Ejection_Fraction"] *= 0.5
+            status, _ = _post(url + "/predict", p)
+            assert status == 200
+        _, body = _get(url + "/debug/quality")
+        q = json.loads(body)
+        assert q["status"] == "alert"
+        top2 = {f["name"] for f in q["features"][:2]}
+        assert top2 == {"Max_Wall_Thick", "Ejection_Fraction"}
+        _, body = _get(url + "/healthz")
+        hq = json.loads(body)["quality"]
+        assert hq["status"] == "alert"
+        assert hq["worst_feature"] in top2
+        assert hq["worst_psi"] >= 0.25
+
+        _, page = _get(url + "/metrics")
+        assert "quality_feature_psi" in page
+        assert "quality_status_transitions_total" in page
+        assert validate_metrics.validate(page) == []
+    finally:
+        handle.shutdown()
+        journal.set_journal(None)
+        jrn.close()
+    events = [json.loads(line) for line in open(tmp_path / "quality.jsonl")]
+    trans = [e for e in events if e.get("kind") == "quality_status"]
+    assert trans and trans[0]["from_status"] == "ok"
+    assert trans[-1]["to_status"] == "alert"
+
+
+def test_no_quality_flag_disables_even_with_profile(
+    stacking_params, quality_cohort
+):
+    _, profile = quality_cohort
+    handle = make_server(
+        stacking_params, port=0, buckets=(1,), max_wait_ms=1.0,
+        quality_profile=profile, no_quality=True, warmup=False,
+    ).start_background()
+    try:
+        host, port = handle.address
+        _, body = _get(f"http://{host}:{port}/debug/quality")
+        assert json.loads(body)["enabled"] is False
+        assert handle.engine.quality is None
+    finally:
+        handle.shutdown()
+
+
+def test_loadgen_perturb_spec_and_onset(served, quality_cohort, tmp_path):
+    """Satellite: loadgen --perturb shifts the named variables from the
+    --perturb-at point on and records spec + onset in the artifact."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    # unit-level: spec parsing and application
+    ops = loadgen.parse_perturb(
+        "Ejection_Fraction*0.6,Max_Wall_Thick+8,NYHA_Class=3,Gender-1"
+    )
+    assert ops == [
+        ("Ejection_Fraction", "*", 0.6), ("Max_Wall_Thick", "+", 8.0),
+        ("NYHA_Class", "=", 3.0), ("Gender", "-", 1.0),
+    ]
+    p = loadgen.apply_perturb(dict(EXAMPLE_PATIENT), ops)
+    assert p["Ejection_Fraction"] == 55 * 0.6
+    assert p["Max_Wall_Thick"] == 13 + 8
+    assert p["NYHA_Class"] == 3.0 and p["Gender"] == 0.0
+    with pytest.raises(ValueError, match="bad perturb term"):
+        loadgen.parse_perturb("Ejection_Fraction~2")
+
+    # end-to-end: a perturbed closed loop against the live server, fed a
+    # JSONL cohort, records where the distribution moved
+    X, _ = quality_cohort
+    patients = tmp_path / "patients.jsonl"
+    with open(patients, "w") as f:
+        for row in X[:50]:
+            f.write(json.dumps(_patient_of(row)) + "\n")
+    _, url = served
+    out = tmp_path / "SERVE_BENCH_perturb.json"
+    rc = loadgen.main([
+        "--url", url, "--mode", "closed", "--concurrency", "2",
+        "--duration", "1.0", "--patients", str(patients),
+        "--perturb", "Ejection_Fraction*0.5", "--perturb-at", "0.5",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["n_ok"] > 0 and art["n_err"] == 0
+    assert art["patients"] == str(patients) and art["n_patients"] == 50
+    perturb = art["perturb"]
+    assert perturb["spec"] == "Ejection_Fraction*0.5"
+    assert perturb["at_fraction"] == 0.5
+    assert perturb["onset_index"] is not None
+    assert 0 < perturb["onset_index"] < art["n_sent"]
+    assert perturb["onset_time_s"] >= 0.5
+
+
+def test_pipeline_served_quality_names_follow_support_mask(pipeline_params):
+    """A full-pipeline checkpoint profiles its OWN lasso-selected columns
+    (ascending schema order), not the contract order: the served monitor
+    must pick the profile up from params.quality automatically and label
+    features with the selected schema variable names, or every
+    quality_feature_psi series points at the wrong variable."""
+    from machine_learning_replications_tpu.data.schema import variable_names
+
+    assert pipeline_params.quality is not None  # fit_pipeline recorded it
+    handle = make_server(
+        pipeline_params, port=0, buckets=(1,), warmup=False,
+    ).start_background()
+    try:
+        mask = np.asarray(pipeline_params.support_mask)
+        expected = [variable_names()[i] for i in np.where(mask)[0]]
+        assert list(handle.quality.feature_names) == expected
+        host, port = handle.address
+        _, body = _get(f"http://{host}:{port}/debug/quality")
+        q = json.loads(body)
+        assert q["enabled"] is True
+        assert [f["name"] for f in q["features"]] == sorted(
+            expected, key=expected.index
+        )  # below min_rows every psi is None, so profile order is kept
+    finally:
+        handle.shutdown()
+
+
+def test_quality_feed_failure_quarantined_not_fatal(
+    stacking_params, quality_cohort, tmp_path
+):
+    """Telemetry must never take serving down: a monitor that raises on
+    observe (here: NaN rows from a direct predict() caller — the HTTP
+    path rejects them, but the engine API allows them) is quarantined
+    with a journaled event, and the prediction still succeeds."""
+    from machine_learning_replications_tpu.obs import journal, quality
+    from machine_learning_replications_tpu.obs.registry import MetricsRegistry
+
+    X, profile = quality_cohort
+    mon = quality.QualityMonitor(
+        profile, registry=MetricsRegistry(), min_rows=10, window=64
+    )
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8), quality=mon)
+    jrn = journal.RunJournal(tmp_path / "feed.jsonl", command="serve")
+    journal.set_journal(jrn)
+    try:
+        bad = X[:3].copy()
+        bad[0, 0] = np.nan
+        # the bare route propagates NaN in → NaN out (only the pipeline
+        # route imputes); the point is the CALL succeeds and batchmates
+        # still get finite answers
+        probs = eng.predict(bad)
+        assert probs.shape == (3,) and np.isfinite(probs[1:]).all()
+        assert eng.quality is None  # feed quarantined, not fatal
+        # the quarantine is VISIBLE on every surface still holding the
+        # monitor (ServerHandle keeps its reference for /healthz and
+        # /debug/quality): frozen stats must not present as live 'ok'
+        assert mon.health()["status"] == "disabled"
+        snap = mon.snapshot()
+        assert snap["enabled"] is False and "quarantined" in snap["reason"]
+        eng.predict(X[:3])  # serving continues unobserved
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+    events = [json.loads(line) for line in open(tmp_path / "feed.jsonl")]
+    disabled = [
+        e for e in events if e.get("kind") == "quality_feed_disabled"
+    ]
+    assert len(disabled) == 1 and "finite" in disabled[0]["error"]
+
+
+def test_make_server_rejects_mismatched_profile_width(stacking_params):
+    """A profile built over the wrong space (e.g. pre-selection 64-column
+    rows attached to a bare 17-column ensemble) must fail at startup, not
+    on the first served flush."""
+    from machine_learning_replications_tpu.obs import quality
+
+    from machine_learning_replications_tpu.obs.registry import REGISTRY
+
+    rng = np.random.default_rng(11)
+    X64 = rng.normal(size=(100, 64))
+    wide = quality.build_reference_profile(X64, np.full(100, 0.5))
+    with pytest.raises(ValueError, match="features wide"):
+        make_server(
+            stacking_params, port=0, buckets=(1,), warmup=False,
+            quality_profile=wide,
+        )
+    # the rejection happened BEFORE any monitor existed: no phantom
+    # 64-wide series (f17..f63 fallback names) leaked into the
+    # process-global registry that /metrics renders forever
+    fams = {f.name: f for f in REGISTRY.families()}
+    fam = fams.get("quality_feature_psi")
+    if fam is not None:
+        assert all(
+            "f63" not in label_values
+            for label_values, _ in fam.collect()
+        )

@@ -14,10 +14,24 @@ the two canonical load models:
                 (closed loops self-throttle and hide it).
 
 Every request POSTs a 17-variable patient JSON (the ``predict_hf.py:5-27``
-example by default, ``--patient`` for a file) and is counted as ok
-(HTTP 200), shed (503, the batcher's explicit overload reply), or error.
-The artifact records offered/achieved qps, ok/shed/error counts, shed
-rate, and ok-latency quantiles — the serving counterpart of BENCH_*.json.
+example by default, ``--patient`` for a file, ``--patients`` for a JSONL
+cohort cycled round-robin — drift monitoring needs *distributed* traffic,
+a single repeated patient is a point mass no reference profile matches)
+and is counted as ok (HTTP 200), shed (503, the batcher's explicit
+overload reply), or error. The artifact records offered/achieved qps,
+ok/shed/error counts, shed rate, and ok-latency quantiles — the serving
+counterpart of BENCH_*.json.
+
+``--perturb SPEC`` exercises the server's model-quality monitoring
+(``obs.quality``, ``/debug/quality``) end-to-end: from ``--perturb-at``
+(fraction of the run, default 0.5) onward, every outgoing patient has the
+named variables shifted/scaled — e.g.
+``--perturb 'Ejection_Fraction*0.6,Max_Wall_Thick+8'`` — simulating the
+upstream unit-conversion bug or cohort shift the drift monitor exists to
+catch. The artifact records the spec, the onset request index, and the
+onset time, so a ``/debug/quality`` snapshot or journal
+``quality_status`` transition can be joined against exactly when the
+distribution moved.
 
 The server echoes (or assigns) an ``X-Request-Id`` on every reply; the
 worst-latency request ids land in the artifact (``worst_requests``), so a
@@ -37,11 +51,110 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import threading
 import time
 import urllib.error
 import urllib.request
+
+_PERTURB_TERM_RE = re.compile(
+    r"^\s*(?P<name>.*?)\s*(?P<op>[*+\-=])\s*"
+    r"(?P<val>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)\s*$"
+)
+
+
+def parse_perturb(spec: str) -> list[tuple[str, str, float]]:
+    """``NAME*FACTOR`` / ``NAME+DELTA`` / ``NAME-DELTA`` / ``NAME=VALUE``
+    terms, comma separated. Variable names may contain spaces
+    ("Obstructive HCM"); operands are non-negative literals (use ``-`` to
+    subtract rather than adding a negative)."""
+    ops = []
+    for term in spec.split(","):
+        m = _PERTURB_TERM_RE.match(term)
+        if not m or not m.group("name"):
+            raise ValueError(
+                f"bad perturb term {term.strip()!r}: expected "
+                "NAME*FACTOR, NAME+DELTA, NAME-DELTA, or NAME=VALUE"
+            )
+        ops.append((m.group("name"), m.group("op"), float(m.group("val"))))
+    return ops
+
+
+def apply_perturb(
+    patient: dict, ops: list[tuple[str, str, float]]
+) -> dict:
+    out = dict(patient)
+    for name, op, val in ops:
+        v = out[name]
+        out[name] = (
+            v * val if op == "*" else v + val if op == "+"
+            else v - val if op == "-" else val
+        )
+    return out
+
+
+class _Bodies:
+    """Per-request POST bodies: the patient cohort cycled round-robin,
+    with the perturbation switched on mid-run. ``arm(t0)`` fixes the
+    onset clock when the load loop starts; the first request issued at or
+    after onset records its index (the artifact's ``onset_index``)."""
+
+    def __init__(self, patients: list[dict], perturb_ops, onset_frac,
+                 duration: float) -> None:
+        self.patients = patients
+        self.ops = perturb_ops
+        self.onset_frac = onset_frac
+        self.duration = duration
+        self.onset_at: float | None = None  # monotonic; None = no perturb
+        self.onset_index: int | None = None
+        self.onset_time_s: float | None = None
+        self._t0 = 0.0
+        self._lock = threading.Lock()
+        self._i = 0
+        if self.ops:
+            missing = [
+                name for name, _, _ in self.ops
+                if any(name not in p for p in patients)
+            ]
+            if missing:
+                raise ValueError(
+                    f"perturb names not in every patient: {missing}"
+                )
+
+    def arm(self, t0: float) -> None:
+        self._t0 = t0
+        if self.ops:
+            self.onset_at = t0 + self.onset_frac * self.duration
+
+    def next_body(self) -> bytes:
+        now = time.monotonic()
+        with self._lock:
+            i = self._i
+            self._i += 1
+            active = self.onset_at is not None and now >= self.onset_at
+            if active and self.onset_index is None:
+                self.onset_index = i
+                self.onset_time_s = now - self._t0
+        p = self.patients[i % len(self.patients)]
+        if active:
+            p = apply_perturb(p, self.ops)
+        return json.dumps(p).encode()
+
+    def describe(self) -> dict | None:
+        if not self.ops:
+            return None
+        return {
+            "spec": ",".join(
+                f"{name}{op}{val:g}" for name, op, val in self.ops
+            ),
+            "at_fraction": self.onset_frac,
+            "onset_index": self.onset_index,
+            "onset_time_s": (
+                None if self.onset_time_s is None
+                else round(self.onset_time_s, 3)
+            ),
+        }
 
 
 def _percentiles(xs: list[float], qs=(50, 95, 99)) -> dict[str, float | None]:
@@ -101,9 +214,9 @@ class _Tally:
         ]
 
 
-def _fire(url: str, body: bytes, timeout: float, tally: _Tally) -> None:
+def _fire(url: str, bodies: _Bodies, timeout: float, tally: _Tally) -> None:
     req = urllib.request.Request(
-        url + "/predict", data=body,
+        url + "/predict", data=bodies.next_body(),
         headers={"Content-Type": "application/json"},
     )
     t0 = time.monotonic()
@@ -122,15 +235,16 @@ def _fire(url: str, body: bytes, timeout: float, tally: _Tally) -> None:
     tally.record(status, (time.monotonic() - t0) * 1000.0, rid)
 
 
-def run_closed(url, body, duration, concurrency, timeout, tally):
-    stop = time.monotonic() + duration
+def run_closed(url, bodies, duration, concurrency, timeout, tally):
+    t0 = time.monotonic()
+    bodies.arm(t0)
+    stop = t0 + duration
 
     def worker():
         while time.monotonic() < stop:
-            _fire(url, body, timeout, tally)
+            _fire(url, bodies, timeout, tally)
 
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
-    t0 = time.monotonic()
     for t in threads:
         t.start()
     for t in threads:
@@ -138,7 +252,7 @@ def run_closed(url, body, duration, concurrency, timeout, tally):
     return time.monotonic() - t0
 
 
-def run_open(url, body, duration, qps, timeout, tally):
+def run_open(url, bodies, duration, qps, timeout, tally):
     """Fixed-rate schedule; each request gets its own thread so a slow
     server cannot throttle the offered rate (the point of an open loop).
     A bound on in-flight threads keeps a wedged server from spawning
@@ -149,6 +263,7 @@ def run_open(url, body, duration, qps, timeout, tally):
     inflight = threading.Semaphore(max(64, int(4 * qps)))
     threads = []
     t0 = time.monotonic()
+    bodies.arm(t0)
     for i in range(n):
         target = t0 + i * interval
         delay = target - time.monotonic()
@@ -160,7 +275,7 @@ def run_open(url, body, duration, qps, timeout, tally):
 
         def one():
             try:
-                _fire(url, body, timeout, tally)
+                _fire(url, bodies, timeout, tally)
             finally:
                 inflight.release()
 
@@ -183,12 +298,39 @@ def main(argv=None) -> int:
     ap.add_argument("--qps", type=float, default=100.0, help="open-loop rate")
     ap.add_argument("--timeout", type=float, default=30.0)
     ap.add_argument("--patient", help="patient JSON file (default: example)")
+    ap.add_argument(
+        "--patients",
+        help="JSONL file of patient dicts, cycled round-robin — the "
+        "distributed-traffic mode drift monitoring needs",
+    )
+    ap.add_argument(
+        "--perturb", default=None, metavar="SPEC",
+        help="shift/scale patient variables mid-run, e.g. "
+        "'Ejection_Fraction*0.6,Max_Wall_Thick+8' (ops: * + - =); the "
+        "spec and onset land in the artifact",
+    )
+    ap.add_argument(
+        "--perturb-at", type=float, default=0.5, metavar="FRAC",
+        help="fraction of the run after which --perturb activates "
+        "(default 0.5; 0 perturbs from the first request)",
+    )
     ap.add_argument("--out", default=None, help="artifact path (JSON)")
     args = ap.parse_args(argv)
+    if args.patient and args.patients:
+        ap.error("--patient and --patients are mutually exclusive")
+    if not 0.0 <= args.perturb_at <= 1.0:
+        ap.error("--perturb-at must be in [0, 1]")
 
-    if args.patient:
+    if args.patients:
+        with open(args.patients) as f:
+            patients = [json.loads(line) for line in f if line.strip()]
+        if not patients:
+            ap.error(f"--patients {args.patients}: no patient lines")
+        patients_src = args.patients
+    elif args.patient:
         with open(args.patient) as f:
-            patient = json.load(f)
+            patients = [json.load(f)]
+        patients_src = args.patient
     else:
         # Script-relative, not CWD-relative: the tool must find the
         # package when invoked as /path/to/repo/tools/loadgen.py from
@@ -200,19 +342,21 @@ def main(argv=None) -> int:
             EXAMPLE_PATIENT,
         )
 
-        patient = EXAMPLE_PATIENT
-    body = json.dumps(patient).encode()
+        patients = [dict(EXAMPLE_PATIENT)]
+        patients_src = "example"
+    perturb_ops = parse_perturb(args.perturb) if args.perturb else []
+    bodies = _Bodies(patients, perturb_ops, args.perturb_at, args.duration)
 
     tally = _Tally()
     if args.mode == "closed":
         wall = run_closed(
-            args.url, body, args.duration, args.concurrency, args.timeout,
+            args.url, bodies, args.duration, args.concurrency, args.timeout,
             tally,
         )
         offered = None
     else:
         wall = run_open(
-            args.url, body, args.duration, args.qps, args.timeout, tally
+            args.url, bodies, args.duration, args.qps, args.timeout, tally
         )
         offered = args.qps
 
@@ -235,6 +379,9 @@ def main(argv=None) -> int:
             for k, v in _percentiles(tally.ok_latency_ms).items()
         },
         "worst_requests": tally.worst_requests(),
+        "patients": patients_src,
+        "n_patients": len(patients),
+        "perturb": bodies.describe(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
     }
     line = json.dumps(artifact, indent=1)

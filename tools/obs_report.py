@@ -17,10 +17,13 @@ it was queue wait behind a cold-bucket flush".
 Sources (mix live and file freely; stdlib only):
 
   --url URL        live server: fetches /healthz, /metrics?format=json,
-                   /debug/requests
+                   /debug/requests, /debug/quality
   --journal PATH   JSONL run journal (manifest + events)
   --metrics PATH   a saved /metrics?format=json snapshot
   --requests PATH  a saved /debug/requests snapshot
+  --quality PATH   a saved /debug/quality snapshot (the "Model quality"
+                   section: drift status, worst features, calibration,
+                   journaled status transitions)
   --bench PATH     a loadgen SERVE_BENCH_*.json artifact (enables the join)
   --out PATH       write the report there (default: stdout)
 
@@ -36,6 +39,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import urllib.error
 import urllib.request
 
 
@@ -196,6 +200,80 @@ def _section_slo(rep: Report, slos: list | None):
     )
 
 
+def _section_quality(
+    rep: Report, quality: dict | None, events: list[dict],
+    bench: dict | None, n_worst: int = 5,
+):
+    rep.h("Model quality")
+    if quality is None:
+        rep.kv("quality", "unavailable (no --quality / --url)")
+        return
+    if not quality.get("enabled", False):
+        rep.kv("quality", f"disabled ({quality.get('reason', 'no reason given')})")
+        return
+    rep.kv("drift status", quality.get("status"))
+    th = quality.get("thresholds") or {}
+    rep.kv(
+        "thresholds",
+        f"warn PSI >= {th.get('warn_psi')}, alert PSI >= {th.get('alert_psi')}",
+    )
+    rep.kv(
+        "window", f"{quality.get('window_rows')} rows "
+        f"(of {quality.get('rows_total')} observed; "
+        f"min {quality.get('min_rows')} to judge)",
+    )
+    rep.kv("score-distribution PSI", _fmt(quality.get("score_psi"), 4))
+    rep.kv(
+        "member disagreement (windowed mean pairwise)",
+        _fmt(quality.get("member_disagreement"), 4),
+    )
+    ref = quality.get("reference") or {}
+    if ref:
+        rep.kv(
+            "reference profile",
+            f"{ref.get('n_rows')} training rows, "
+            f"{ref.get('feature_bins')} feature bins",
+        )
+    perturb = (bench or {}).get("perturb")
+    if perturb:
+        rep.kv(
+            "bench perturbation",
+            f"{perturb.get('spec')} from request "
+            f"{perturb.get('onset_index')} "
+            f"({perturb.get('onset_time_s')} s into the run)",
+        )
+    features = quality.get("features") or []
+    if features:
+        rep.lines.append("")
+        rep.table(
+            ("feature", "PSI", "binned KS", "window mean", "training mean"),
+            [
+                (
+                    f.get("name"), _fmt(f.get("psi"), 4),
+                    _fmt(f.get("ks"), 4),
+                    _fmt(f.get("window_mean_binned"), 3),
+                    _fmt(f.get("reference_mean"), 3),
+                )
+                for f in features[:n_worst]
+            ],
+        )
+    transitions = [e for e in events if e.get("kind") == "quality_status"]
+    if transitions:
+        rep.lines.append("")
+        rep.table(
+            ("when", "transition", "worst feature", "PSI", "window rows"),
+            [
+                (
+                    e.get("ts"),
+                    f"{e.get('from_status')} → {e.get('to_status')}",
+                    e.get("worst_feature"), _fmt(e.get("worst_psi"), 4),
+                    e.get("window_rows"),
+                )
+                for e in transitions
+            ],
+        )
+
+
 def _phase_summary(trace: dict) -> str:
     phases = trace.get("phases") or {}
     parts = []
@@ -314,15 +392,17 @@ def main(argv=None) -> int:
     ap.add_argument("--journal", help="JSONL run journal path")
     ap.add_argument("--metrics", help="saved /metrics?format=json snapshot")
     ap.add_argument("--requests", help="saved /debug/requests snapshot")
+    ap.add_argument("--quality", help="saved /debug/quality snapshot")
     ap.add_argument("--bench", help="loadgen SERVE_BENCH_*.json artifact")
     ap.add_argument("--tail", type=int, default=10,
                     help="slowest sampled traces to show")
     ap.add_argument("--out", help="report path (default: stdout)")
     args = ap.parse_args(argv)
-    if not (args.url or args.journal or args.metrics or args.requests):
+    if not (args.url or args.journal or args.metrics or args.requests
+            or args.quality):
         ap.error("nothing to report on: give --url and/or input files")
 
-    health = metrics = requests = None
+    health = metrics = requests = quality = None
     if args.url:
         base = args.url.rstrip("/")
         health = _fetch_json(base + "/healthz")
@@ -331,10 +411,16 @@ def main(argv=None) -> int:
         # count): the endpoint's n=64 default would silently drop the
         # very samples the Bench join needs.
         requests = _fetch_json(base + "/debug/requests?n=1000000")
+        try:
+            quality = _fetch_json(base + "/debug/quality")
+        except urllib.error.HTTPError:
+            quality = None  # pre-quality server: section reads unavailable
     if args.metrics:
         metrics = _load_json(args.metrics)
     if args.requests:
         requests = _load_json(args.requests)
+    if args.quality:
+        quality = _load_json(args.quality)
     manifest, events = (
         _read_journal(args.journal) if args.journal else (None, [])
     )
@@ -346,6 +432,7 @@ def main(argv=None) -> int:
     _section_runtime(rep, (metrics or {}).get("runtime"))
     slos = (requests or {}).get("slo")
     _section_slo(rep, slos)
+    _section_quality(rep, quality, events, bench)
     _section_tail(rep, requests, n=args.tail)
     if args.journal:
         _section_journal(rep, events)
