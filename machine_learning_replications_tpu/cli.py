@@ -278,6 +278,15 @@ def cmd_serve(args) -> int:
         "no_quality": args.no_quality,
         "drift_warn_psi": args.drift_warn_psi,
         "drift_alert_psi": args.drift_alert_psi,
+        "supervise": not args.no_supervise,
+        "flush_deadline_s": args.flush_deadline_s,
+        "breaker_failures": args.breaker_failures,
+        "restart_backoff_s": args.restart_backoff_s,
+        "restart_backoff_max_s": args.restart_backoff_max_s,
+        "inject": sorted(args.inject or []),
+        # The journaled audit record must state the ACTUAL exposure:
+        # --inject implies the endpoint too.
+        "fault_endpoint": bool(args.inject or args.fault_endpoint),
     }, sort_keys=True)
     with _observed(args, "serve", config_json=serve_cfg):
         return _run_serve(args, buckets)
@@ -287,8 +296,23 @@ def _run_serve(args, buckets) -> int:
     import signal
 
     from machine_learning_replications_tpu.obs import slo
-    from machine_learning_replications_tpu.persist import load_inference_params
+    from machine_learning_replications_tpu.resilience import faults
     from machine_learning_replications_tpu.serve import make_server
+
+    # Arm injections BEFORE the model loads or the engine warms: the
+    # persist.restore / engine.warmup faultpoints are part of the chaos
+    # surface (docs/RESILIENCE.md).
+    for spec in args.inject or []:
+        try:
+            armed = faults.arm(spec)
+        except ValueError as exc:
+            raise SystemExit(f"--inject: {exc}")
+        print(f"fault armed: {armed.describe()}", file=sys.stderr)
+    # The one-way endpoint enable is owned by make_server's fault_endpoint
+    # parameter (passed below) — one code path for a security-relevant
+    # switch.
+
+    from machine_learning_replications_tpu.persist import load_inference_params
 
     params = load_inference_params(model=args.model, pkl=args.pkl)
     handle = make_server(
@@ -316,6 +340,12 @@ def _run_serve(args, buckets) -> int:
         no_quality=args.no_quality,
         drift_warn_psi=args.drift_warn_psi,
         drift_alert_psi=args.drift_alert_psi,
+        supervise=not args.no_supervise,
+        flush_deadline_s=args.flush_deadline_s,
+        breaker_failures=args.breaker_failures,
+        restart_backoff_s=args.restart_backoff_s,
+        restart_backoff_max_s=args.restart_backoff_max_s,
+        fault_endpoint=bool(args.inject or args.fault_endpoint),
     )
     host, port = handle.address
     print(
@@ -562,6 +592,42 @@ def build_parser() -> argparse.ArgumentParser:
         "--drift-alert-psi", type=float, default=0.25,
         help="PSI at or above which drift status becomes 'alert' (served "
         "cohort no longer resembles the training cohort)",
+    )
+    v.add_argument(
+        "--no-supervise", action="store_true",
+        help="run the engine bare: no watchdog deadline, no circuit "
+        "breaker, no supervised restart (docs/RESILIENCE.md)",
+    )
+    v.add_argument(
+        "--flush-deadline-s", type=float, default=20.0,
+        help="watchdog deadline per flushed compute; a compute that "
+        "misses it is abandoned as wedged and the breaker opens",
+    )
+    v.add_argument(
+        "--breaker-failures", type=int, default=3,
+        help="consecutive compute failures that open the circuit breaker "
+        "(degraded mode: /predict sheds 503 + Retry-After while the "
+        "engine restarts)",
+    )
+    v.add_argument(
+        "--restart-backoff-s", type=float, default=0.5,
+        help="initial supervised-restart backoff (doubles per attempt)",
+    )
+    v.add_argument(
+        "--restart-backoff-max-s", type=float, default=30.0,
+        help="supervised-restart backoff cap",
+    )
+    v.add_argument(
+        "--inject", action="append", metavar="SPEC", default=None,
+        help="arm a faultpoint (repeatable): SITE:MODE[=ARG][@OPTS], e.g. "
+        "engine.compute:raise@n=5 or batcher.flush:delay=0.5@p=0.1,seed=7 "
+        "— also enables the /debug/faults endpoint "
+        "(docs/RESILIENCE.md faultpoint catalog)",
+    )
+    v.add_argument(
+        "--fault-endpoint", action="store_true",
+        help="enable the guarded /debug/faults chaos endpoint without "
+        "arming anything at startup",
     )
     v.add_argument("--verbose", action="store_true", help="log each request")
     add_obs_flags(v)

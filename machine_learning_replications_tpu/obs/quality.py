@@ -583,6 +583,20 @@ class QualityMonitor:
             self._disabled_reason = reason
         self._g_status.get().set(float("nan"))
 
+    def reenable(self) -> bool:
+        """Clear a quarantine (``resilience.supervisor`` calls this after a
+        successful engine restart rebuilds the feed): the monitor resumes
+        with its windows intact and the status gauge restored. True when a
+        quarantine was actually cleared — the caller journals the
+        transition (``quality_feed_reenabled``) only then."""
+        with self._lock:
+            was_disabled = self._disabled_reason is not None
+            self._disabled_reason = None
+            status = self._status
+        if was_disabled:
+            self._g_status.get().set(float(_STATUS_LEVEL[status]))
+        return was_disabled
+
     # -- export -------------------------------------------------------------
 
     @property

@@ -19,19 +19,42 @@ detection": scripts crash on any error). Here:
 Checkpoints are directories of tensorstore arrays — sharded arrays save and
 restore with their ``NamedSharding`` preserved, so the same code path serves
 single-chip and mesh-sharded state.
+
+**Integrity and rollback (docs/RESILIENCE.md).** ``save_params`` /
+``save_model`` publish *atomically*: the whole checkpoint tree is built in
+a same-parent temp directory, a content-checksum manifest
+(``integrity.json``: sha256 + size per file) is written over it, any
+existing checkpoint at the path is rotated to its last-known-good slot
+(``resilience.lastgood``), and only then is the temp dir renamed into
+place — a crash at any point leaves either the old checkpoint or the new
+one, never a torn mix. ``restore_params`` verifies the manifest before
+handing the directory to Orbax, so corruption fails loudly
+(``CheckpointIntegrityError``) instead of deserializing garbage weights;
+``load_model`` additionally falls back to the retained last-known-good on
+any restore failure (journaled ``checkpoint_rollback``). The
+``persist.save`` / ``persist.restore`` faultpoints
+(``resilience.faults``) tear these paths on demand for chaos tests.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import shutil
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
 
+from machine_learning_replications_tpu.resilience import faults, lastgood
+
 
 class SimulatedInterrupt(RuntimeError):
     """Raised by test hooks to emulate preemption mid-training."""
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """The checkpoint's content does not match its integrity manifest."""
 
 
 def abstract_like(params: Any, *, keep_sharding: bool = True) -> Any:
@@ -52,18 +75,197 @@ def abstract_like(params: Any, *, keep_sharding: bool = True) -> Any:
     return jax.tree.map(leaf, params)
 
 
+_INTEGRITY_FILE = "integrity.json"
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _payload_files(path: str) -> list[str]:
+    """Every file under the checkpoint dir except the integrity manifest
+    itself, as sorted relpaths — the checksum domain."""
+    out = []
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            rel = os.path.relpath(os.path.join(root, name), path)
+            if rel != _INTEGRITY_FILE:
+                out.append(rel)
+    return sorted(out)
+
+
+def _write_integrity(path: str) -> None:
+    """Content-checksum manifest over the finished checkpoint tree
+    (sha256 + byte size per file). Written last in the temp dir, before
+    the atomic publish rename."""
+    import json
+
+    files = {}
+    for rel in _payload_files(path):
+        fp = os.path.join(path, rel)
+        files[rel] = {
+            "sha256": _file_sha256(fp), "bytes": os.path.getsize(fp),
+        }
+    fp = os.path.join(path, _INTEGRITY_FILE)
+    with open(fp, "w") as f:
+        json.dump({"format": 1, "files": files}, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def verify_checkpoint(path: str | os.PathLike, *, deep: bool = True) -> bool:
+    """Check the checkpoint's files against its integrity manifest.
+
+    True when verified; False when the checkpoint predates integrity
+    manifests (no ``integrity.json`` — tolerated so legacy checkpoints
+    keep restoring). Raises ``CheckpointIntegrityError`` on any missing,
+    truncated, or content-mismatched file — BEFORE Orbax deserializes
+    anything from it. ``deep=False`` skips the sha256 pass (existence +
+    size only): the cheap tier for guards that run per save, where a full
+    re-read of the previous checkpoint would roughly triple checkpoint
+    I/O — content-level rot is still caught loudly by the deep check
+    every restore runs."""
+    import json
+
+    path = os.path.abspath(os.fspath(path))
+    manifest_path = os.path.join(path, _INTEGRITY_FILE)
+    if not os.path.exists(manifest_path):
+        return False
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, json.JSONDecodeError, KeyError) as exc:
+        raise CheckpointIntegrityError(
+            f"unreadable integrity manifest in {path!r}: "
+            f"{type(exc).__name__}: {exc}"
+        ) from exc
+    for rel, spec in sorted(files.items()):
+        fp = os.path.join(path, rel)
+        if not os.path.exists(fp):
+            raise CheckpointIntegrityError(
+                f"checkpoint {path!r} is missing {rel!r}"
+            )
+        size = os.path.getsize(fp)
+        if size != spec["bytes"]:
+            raise CheckpointIntegrityError(
+                f"checkpoint file {rel!r} is {size} bytes, manifest says "
+                f"{spec['bytes']} (torn write?)"
+            )
+        if not deep:
+            continue
+        # Size matched: hash the content (the expensive check last).
+        digest = _file_sha256(fp)
+        if digest != spec["sha256"]:
+            raise CheckpointIntegrityError(
+                f"checkpoint file {rel!r} content hash mismatch "
+                f"({digest[:16]}… != manifest {spec['sha256'][:16]}…)"
+            )
+    return True
+
+
+def _corrupt_payload(path: str) -> None:
+    """Chaos-only (``persist.*:corrupt`` faultpoints): flip the first byte
+    of the largest payload file so integrity verification must catch it."""
+    best, best_size = None, -1
+    for rel in _payload_files(path):
+        size = os.path.getsize(os.path.join(path, rel))
+        if size > best_size:
+            best, best_size = os.path.join(path, rel), size
+    if best is None:
+        return
+    with open(best, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]) if first else b"\x00")
+
+
+def _orbax_save(path: str, params: Any) -> None:
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+
+
+def _publish_tree(path: str, write_tree, *, force: bool = True) -> None:
+    """Atomic checkpoint publish. ``write_tree(tmp)`` builds the complete
+    checkpoint in a same-parent temp directory; the integrity manifest is
+    written over it; the checkpoint previously at ``path`` (if any) is
+    rotated to its last-known-good slot; then one ``os.rename`` makes the
+    new tree visible. A crash anywhere leaves the old checkpoint intact
+    (or, in the narrow window after rotation, the last-known-good — which
+    ``load_model``'s rollback path finds)."""
+    path = os.path.abspath(os.fspath(path))
+    if not force and os.path.exists(path):
+        raise FileExistsError(f"checkpoint already exists at {path!r}")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    try:
+        write_tree(tmp)
+        # Faultpoint BETWEEN the tree write and the publish: raise =
+        # "save interrupted mid-write" (tmp discarded, the published
+        # checkpoint untouched); corrupt = bytes torn after checksumming
+        # (detected at restore).
+        corrupt = faults.fire("persist.save")
+        _write_integrity(tmp)
+        if corrupt:
+            _corrupt_payload(tmp)
+        # Rotate the outgoing primary into the lastgood slot ONLY if it
+        # still verifies: rotating a primary that rotted on disk since
+        # publish would destroy a genuinely good lastgood — the exact
+        # rollback net this transaction exists to maintain. A failed
+        # verification keeps the old lastgood and discards the bad
+        # primary (it is being replaced anyway), journaled. Shallow
+        # (size-only) on purpose: this guard runs on EVERY save, and a
+        # full re-hash of the previous checkpoint would roughly triple
+        # checkpoint I/O; content-level rot that slips through still
+        # fails loudly at restore time (every restore hash-verifies, and
+        # restore_with_fallback lets a bad lastgood raise).
+        if os.path.isdir(path):
+            try:
+                verify_checkpoint(path, deep=False)
+            except CheckpointIntegrityError as exc:
+                from machine_learning_replications_tpu.obs import journal
+
+                journal.event(
+                    "checkpoint_retain_skipped", path=path,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                shutil.rmtree(path)
+            else:
+                lastgood.retain(path)
+        os.rename(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
 def save_params(path: str | os.PathLike, params: Any, *, force: bool = True) -> None:
     """Write ``params`` (any pytree of arrays) as an Orbax checkpoint at
-    ``path`` (created; overwritten when ``force``). Blocks until durable."""
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(os.fspath(path)), params, force=force)
+    ``path``, published atomically with an integrity manifest; an existing
+    checkpoint there is retained as last-known-good (``force``) rather
+    than destroyed. Blocks until durable."""
+    _publish_tree(
+        os.path.abspath(os.fspath(path)),
+        lambda tmp: _orbax_save(tmp, params),
+        force=force,
+    )
 
 
 def restore_params(path: str | os.PathLike, template: Any) -> Any:
     """Read the checkpoint at ``path`` into the structure of ``template``
-    (a concrete pytree or one from ``abstract_like``)."""
+    (a concrete pytree or one from ``abstract_like``), verifying its
+    integrity manifest first (``CheckpointIntegrityError`` on corruption;
+    manifest-less legacy checkpoints restore unverified)."""
+    path = os.path.abspath(os.fspath(path))
+    if faults.fire("persist.restore"):
+        _corrupt_payload(path)
+    verify_checkpoint(path)
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(os.path.abspath(os.fspath(path)), template)
+        return ckptr.restore(path, template)
 
 
 _TEMPLATE_FILE = "pytree_template.json"
@@ -157,29 +359,24 @@ def save_model(path: str | os.PathLike, params: Any) -> None:
     be restored *without* the caller reconstructing a template pytree (the
     CLI's load path). The sidecar is JSON: the params' dataclass structure
     by *name* (resolved against a fixed registry at load) plus shape/dtype
-    per array leaf and plain values for static fields."""
-    import json
-    import tempfile
+    per array leaf and plain values for static fields.
 
-    path = os.path.abspath(os.fspath(path))
-    save_params(path, params)
-    sidecar = {"format": 1, "root": _encode_template(params)}
-    # Atomic publish: the sidecar's existence is the durability marker
-    # (StageCheckpointer.completed), so it must never exist half-written.
-    # Write to a temp file in the same directory, fsync, then os.replace.
-    fd, tmp = tempfile.mkstemp(
-        prefix=_TEMPLATE_FILE + ".", suffix=".tmp", dir=path
-    )
-    try:
-        with os.fdopen(fd, "w") as f:
+    The sidecar is part of the same atomic publish as the arrays (one temp
+    tree, one rename): its existence is the durability marker
+    (``StageCheckpointer.completed``), and it is covered by the integrity
+    manifest, so a present sidecar implies a complete, checksummed
+    checkpoint."""
+    import json
+
+    def write_tree(tmp: str) -> None:
+        _orbax_save(tmp, params)
+        sidecar = {"format": 1, "root": _encode_template(params)}
+        with open(os.path.join(tmp, _TEMPLATE_FILE), "w") as f:
             json.dump(sidecar, f, indent=1)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(path, _TEMPLATE_FILE))
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+
+    _publish_tree(os.path.abspath(os.fspath(path)), write_tree)
 
 
 def load_model(path: str | os.PathLike) -> Any:
@@ -188,11 +385,22 @@ def load_model(path: str | os.PathLike) -> Any:
     on the default device; re-shard afterwards for mesh use
     (``data.shard_rows`` / ``NamedSharding``).
 
+    When the checkpoint fails to restore — integrity mismatch, torn or
+    missing files — and a retained last-known-good sibling exists
+    (``resilience.lastgood``), the load falls back to it with a journaled
+    ``checkpoint_rollback``: a bad deploy serves the previous model
+    instead of killing the process. Without a retained fallback the
+    failure propagates.
+
     Full-pipeline checkpoints written before the quality reference profile
     existed (their sidecar's ``PipelineParams`` node has no ``quality``
     field) restore cleanly — the dataclass default fills ``None`` — with a
     single journaled warning, so a serving process built on one says *why*
     its drift monitoring is off instead of silently lacking it."""
+    return lastgood.restore_with_fallback(path, _load_model_at)
+
+
+def _load_model_at(path: str) -> Any:
     import json
 
     path = os.path.abspath(os.fspath(path))

@@ -1,0 +1,84 @@
+"""Last-known-good checkpoint retention and rollback.
+
+A model deploy is a checkpoint swap, and a checkpoint is a directory of
+files any of which can be torn by a crash, a partial copy, or bit rot.
+``persist.orbax_io`` publishes checkpoints atomically (build in a temp
+dir, checksum, rename into place) and calls ``retain`` in the same
+transaction: the checkpoint previously at the path is *rotated to a
+sibling ``<path>.lastgood`` directory* instead of deleted.
+
+``restore_with_fallback`` is the read side: when loading the primary
+checkpoint fails — integrity mismatch, torn files, a crash that left only
+the rotated-away previous version — it falls back to the retained
+last-known-good, journals a ``checkpoint_rollback`` event, and counts it
+(``resilience_checkpoint_rollbacks_total``). A bad deploy therefore
+degrades to serving the *previous* model (loudly: the journal and metrics
+say so) instead of a dead server.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from machine_learning_replications_tpu.obs import journal
+from machine_learning_replications_tpu.obs.registry import REGISTRY
+
+LASTGOOD_SUFFIX = ".lastgood"
+
+CHECKPOINT_ROLLBACKS = REGISTRY.counter(
+    "resilience_checkpoint_rollbacks_total",
+    "Checkpoint loads that fell back to the retained last-known-good "
+    "after the primary failed to restore.",
+)
+
+
+def lastgood_path(path: str | os.PathLike) -> str:
+    """The sibling directory where a checkpoint's previous version is
+    retained (``<path>.lastgood``)."""
+    return os.path.abspath(os.fspath(path)).rstrip(os.sep) + LASTGOOD_SUFFIX
+
+
+def retain(path: str | os.PathLike) -> bool:
+    """Rotate the existing checkpoint at ``path`` (if any) into its
+    last-known-good slot, replacing an older retained version. Called by
+    the atomic publish in ``persist.orbax_io`` just before the new
+    checkpoint is renamed into place; True when something was retained."""
+    path = os.path.abspath(os.fspath(path))
+    if not os.path.isdir(path):
+        return False
+    lg = lastgood_path(path)
+    if os.path.isdir(lg):
+        shutil.rmtree(lg)
+    os.rename(path, lg)
+    return True
+
+
+def restore_with_fallback(path: str | os.PathLike, loader):
+    """``loader(path)``, falling back to ``loader(lastgood_path(path))``
+    when the primary raises and a retained last-known-good exists.
+
+    The rollback is LOUD: journaled (``checkpoint_rollback`` with the
+    primary's error) and counted — serving yesterday's model silently
+    would be as dangerous as the corruption itself. Without a retained
+    fallback the original failure propagates unchanged."""
+    path = os.path.abspath(os.fspath(path))
+    try:
+        return loader(path)
+    except Exception as exc:
+        lg = lastgood_path(path)
+        if not os.path.isdir(lg):
+            raise
+        err = f"{type(exc).__name__}: {exc}"
+        out = loader(lg)  # a bad lastgood raises here — nothing to hide
+        CHECKPOINT_ROLLBACKS.inc()
+        journal.event(
+            "checkpoint_rollback", path=path, lastgood=lg, error=err,
+        )
+        from machine_learning_replications_tpu.utils.trace import stage_say
+
+        stage_say(
+            f"checkpoint {path!r} failed to restore ({err}) — rolled back "
+            f"to last-known-good {lg!r}"
+        )
+        return out

@@ -10,7 +10,14 @@ no subsystem behind it. This package is that subsystem, stdlib-only:
                bounded admission with explicit load shedding, graceful
                drain
   ``server``   HTTP front end: ``/predict`` (17-variable patient JSON),
-               ``/healthz``, ``/metrics``
+               ``/healthz`` (liveness) + ``/readyz`` (readiness),
+               ``/metrics``, and the guarded ``/debug/*`` surfaces
+               (requests, profile, quality, faults)
+
+The engine runs supervised by default (``resilience.supervisor``):
+watchdog deadline per flush, circuit breaker, degraded-mode 503 +
+``Retry-After`` shedding, and bounded-backoff restart —
+docs/RESILIENCE.md.
   ``metrics``  latency quantiles, queue depth, batch-size and
                padding-waste histograms (instrument primitives shared
                with — and re-exported from — ``obs.registry``; /metrics

@@ -30,6 +30,8 @@ from concurrent.futures import Future
 import numpy as np
 
 from machine_learning_replications_tpu.obs import jaxmon, journal, spans
+from machine_learning_replications_tpu.resilience import faults
+from machine_learning_replications_tpu.resilience.supervisor import BreakerOpen
 
 
 class Overloaded(RuntimeError):
@@ -210,8 +212,12 @@ class MicroBatcher:
         try:
             # np.stack inside the try: a mis-shaped row slipping past
             # submit must fail its batch's futures, not kill the flush
-            # thread (which would wedge the batcher permanently).
+            # thread (which would wedge the batcher permanently). The
+            # faultpoint rides inside the same try for the same reason —
+            # an injected flush fault fails THIS batch's futures
+            # explicitly, never the loop.
             with spans.span("serve:flush", rows=len(batch)) as sp:
+                faults.fire("batcher.flush")
                 X = np.stack([p.row for p in batch])
                 t_c0 = time.perf_counter()
                 probs = np.asarray(self._engine.predict(X), np.float64)
@@ -220,11 +226,24 @@ class MicroBatcher:
                 sp.note(flush_seq=flush_seq, bucket=bucket,
                         cold_compile=cold)
         except Exception as exc:
+            # A BreakerOpen from the supervised engine is a degraded-mode
+            # SHED of requests admitted before the breaker opened — the
+            # engine was never invoked and the client gets the same
+            # explicit 503 + Retry-After as the pre-admission path. It
+            # must count in shed_total, not errors_total ('failed inside
+            # the engine'), or every degraded window fires error-rate
+            # alerts for contract-conforming sheds while the shed rate
+            # under-reports.
+            shed = isinstance(exc, BreakerOpen)
             if self._metrics is not None:
-                self._metrics.errors_total.inc(len(batch))
+                counter = (
+                    self._metrics.shed_total if shed
+                    else self._metrics.errors_total
+                )
+                counter.inc(len(batch))
             journal.event(
                 "flush", seq=flush_seq, rows=len(batch), ok=False,
-                error=f"{type(exc).__name__}: {exc}",
+                shed=shed, error=f"{type(exc).__name__}: {exc}",
             )
             # Partial phase record: queue wait and assembly happened, and
             # the compute interval ends where the engine raised — a

@@ -37,6 +37,7 @@ from typing import Sequence
 import numpy as np
 
 from machine_learning_replications_tpu.obs import jaxmon, journal, spans
+from machine_learning_replications_tpu.resilience import faults
 
 DEFAULT_BUCKETS = (1, 8, 64, 512)
 
@@ -206,6 +207,11 @@ class BucketedPredictEngine:
         n = X.shape[0]
         if n == 0:
             return np.empty((0,), np.float64)
+        # Faultpoint: the device-compute injection site. A raise here is a
+        # failing compute (feeds the supervisor's breaker streak); a delay
+        # is a wedged device — it burns inside the supervisor's watchdog
+        # window, the canonical chaos drill. Free when nothing is armed.
+        faults.fire("engine.compute")
         top = self.buckets[-1]
         if n > top:
             return np.concatenate(
@@ -252,6 +258,9 @@ class BucketedPredictEngine:
 
         from machine_learning_replications_tpu.data.examples import patient_row
 
+        # Faultpoint: a raise here makes a supervised restart attempt fail
+        # (the factory re-warms), exercising the bounded-backoff retry.
+        faults.fire("engine.warmup")
         row = patient_row()
         times: dict[int, float] = {}
         for b in self.buckets:

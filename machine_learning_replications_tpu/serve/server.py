@@ -18,13 +18,21 @@ shared micro-batcher, no third-party dependencies):
                   trace (``obs.reqtrace``): parse → queue wait → batch
                   assembly → device compute (cold-compile flagged) →
                   respond.
-  GET  /healthz   liveness/readiness *and* load signal for an external
-                  prober: params family, bucket ladder, warm flag, queue
-                  depth, uptime, the run id from the journal manifest
-                  when one is active, and a compact model-quality block
-                  (``{"status": ok|warn|alert|disabled, "worst_feature",
-                  "worst_psi"}``) so an orchestrator can act on drift
-                  without scraping ``/debug/quality``.
+  GET  /healthz   LIVENESS (always 200 while the process can answer) plus
+                  the load signal an external prober wants: params family,
+                  bucket ladder, warm flag, queue depth, uptime, the run
+                  id from the journal manifest when one is active, a
+                  compact model-quality block (``{"status":
+                  ok|warn|alert|disabled, "worst_feature", "worst_psi"}``),
+                  and — when the engine is supervised — the circuit
+                  breaker's state (``status`` reads ``degraded`` while the
+                  breaker is open). Liveness and readiness are split
+                  deliberately: a recovering replica must be rotated OUT
+                  (readiness false) without being KILLED (liveness true).
+  GET  /readyz    READINESS: 200 only when the engine is warm, the server
+                  is not draining, and the breaker is closed; 503 with the
+                  blocking reasons otherwise — the signal a load balancer
+                  acts on.
   GET  /metrics   Prometheus text exposition (``?format=json`` for the
                   same data as JSON) — ``serve.metrics``, with the
                   process-global ``obs`` registry's exposition appended
@@ -52,14 +60,31 @@ shared micro-batcher, no third-party dependencies):
                   windowed ensemble disagreement. ``{"enabled": false}``
                   when the served params carry no reference profile or
                   the server started with ``--no-quality``.
+  GET/POST /debug/faults
+                  the fault-injection registry (``resilience.faults``):
+                  GET snapshots armed sites and their call/fire counts;
+                  POST ``{"arm": SPEC}`` / ``{"disarm": SITE}`` /
+                  ``{"reset": true}`` drives a chaos run over HTTP. 403
+                  unless the process opted in (``cli serve --inject`` /
+                  ``--fault-endpoint``) — a production server must not be
+                  chaos-drivable by whoever can reach its port.
 
-``ServerHandle.shutdown`` is the graceful path: stop accepting, drain the
-batcher (admitted requests are never dropped), then stop the listener.
+Degraded mode (``resilience.supervisor``, docs/RESILIENCE.md): while the
+supervised engine's circuit breaker is open, ``/predict`` sheds with 503 +
+``Retry-After`` instead of queueing into a dead engine, ``/healthz``
+reports ``degraded`` (still 200 — the process is alive), and ``/readyz``
+goes 503 so load balancers rotate the replica out while the supervisor
+rebuilds and re-warms the engine off the request path.
+
+``ServerHandle.shutdown`` is the graceful path: mark draining (readiness
+drops), stop accepting, drain the batcher (admitted requests are never
+dropped), then stop the listener.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import tempfile
 import threading
@@ -86,6 +111,13 @@ from machine_learning_replications_tpu.obs import (
 )
 from machine_learning_replications_tpu.obs import quality as qualitymod
 from machine_learning_replications_tpu.obs.registry import REGISTRY
+from machine_learning_replications_tpu.resilience import faults
+from machine_learning_replications_tpu.resilience.supervisor import (
+    DEGRADED_SHEDS,
+    BreakerOpen,
+    ComputeDeadlineExceeded,
+    SupervisedEngine,
+)
 from machine_learning_replications_tpu.serve.batcher import (
     MicroBatcher,
     Overloaded,
@@ -100,6 +132,13 @@ from machine_learning_replications_tpu.serve.metrics import ServingMetrics
 # the HTTP reply carries it verbatim so the serving layer inherits the
 # output contract.
 OUTPUT_CONTRACT = "Probability of progressive HF is: {:.2f} %"
+
+
+def _retry_after(seconds: float) -> dict[str, str]:
+    """``Retry-After`` header for degraded-mode sheds: integer seconds,
+    floor 1 (RFC 7231 delta-seconds; a 0 would invite an instant retry
+    stampede against a still-restarting engine)."""
+    return {"Retry-After": str(max(1, math.ceil(seconds)))}
 
 
 class ServerHandle:
@@ -119,6 +158,10 @@ class ServerHandle:
         self.slo_tracker = slo_tracker
         self.profile_dir = profile_dir
         self.quality = quality  # obs.quality.QualityMonitor or None
+        # Graceful-drain marker: set FIRST in shutdown so /readyz drops
+        # before admission closes — a load balancer stops routing here
+        # while in-flight requests finish.
+        self.draining = False
         self._thread: threading.Thread | None = None
 
     @property
@@ -136,14 +179,19 @@ class ServerHandle:
         return self
 
     def shutdown(self, drain: bool = True) -> None:
-        """Graceful stop: close admission (draining by default), then stop
-        the HTTP loop. Safe to call more than once."""
+        """Graceful stop: mark draining (readiness goes false), close
+        admission (draining by default), then stop the HTTP loop. Safe to
+        call more than once."""
+        self.draining = True
         self.batcher.close(drain=drain)
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        close_engine = getattr(self.engine, "close", None)
+        if close_engine is not None:  # supervised: stop the worker thread
+            close_engine()
 
 
 def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
@@ -168,6 +216,7 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
         def _reply(
             self, code: int, body: bytes, ctype: str,
             request_id: str | None = None,
+            headers: dict[str, str] | None = None,
         ) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
@@ -176,21 +225,54 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                 # Echoed (or assigned) correlation id: the client can join
                 # its own latency record against /debug/requests samples.
                 self.send_header("X-Request-Id", request_id)
+            if headers:
+                for k, v in headers.items():
+                    self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _json(self, code: int, obj, request_id: str | None = None) -> None:
+        def _json(
+            self, code: int, obj, request_id: str | None = None,
+            headers: dict[str, str] | None = None,
+        ) -> None:
             self._reply(
                 code, json.dumps(obj).encode(), "application/json",
-                request_id=request_id,
+                request_id=request_id, headers=headers,
             )
+
+        def _readiness_blockers(self) -> list[str]:
+            """Why this replica should NOT receive traffic right now
+            (empty = ready). The three non-ready states are exactly the
+            ones a load balancer must react to without killing the
+            process: still compiling, draining out, or degraded."""
+            reasons = []
+            if not engine.warm:
+                reasons.append("warmup incomplete")
+            if handle.draining:
+                reasons.append("draining")
+            if getattr(engine, "breaker_open", False):
+                reasons.append("degraded: circuit breaker open")
+            return reasons
 
         def do_GET(self) -> None:  # noqa: N802 (http.server API)
             url = urlparse(self.path)
             if url.path == "/healthz":
                 jrn = journal.get_journal()
+                breaker = (
+                    engine.snapshot()
+                    if isinstance(engine, SupervisedEngine) else None
+                )
+                degraded = getattr(engine, "breaker_open", False)
+                blockers = self._readiness_blockers()
                 self._json(200, {
-                    "status": "ok",
+                    # Liveness stays 200 even degraded: the process is
+                    # alive and must NOT be restarted by a prober — the
+                    # supervisor is already rebuilding the engine, and a
+                    # kill would just add a cold start on top.
+                    "status": "degraded" if degraded else "ok",
+                    "ready": not blockers,
+                    "draining": handle.draining,
+                    "breaker": breaker,
                     "params": type(engine.params).__name__,
                     "buckets": list(engine.buckets),
                     "warm": engine.warm,
@@ -211,6 +293,20 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                         else {"status": "disabled"}
                     ),
                 })
+            elif url.path == "/readyz":
+                blockers = self._readiness_blockers()
+                self._json(
+                    200 if not blockers else 503,
+                    {"ready": not blockers, "reasons": blockers},
+                )
+            elif url.path == "/debug/faults":
+                if not faults.endpoint_enabled():
+                    self._json(403, {
+                        "error": "fault-injection endpoint disabled "
+                        "(start serve with --inject or --fault-endpoint)",
+                    })
+                else:
+                    self._json(200, faults.snapshot())
             elif url.path == "/debug/quality":
                 if handle.quality is None:
                     self._json(200, qualitymod.disabled_snapshot(
@@ -277,6 +373,7 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
         def _fail(
             self, trace, status: str, code: int, message: str,
             observe_slo: bool = True,
+            headers: dict[str, str] | None = None,
         ) -> None:
             """Terminal error path for a traced /predict request: reply
             (respond phase stamped around the write), finish + record the
@@ -289,7 +386,8 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
             t0 = time.perf_counter()
             try:
                 self._json(
-                    code, {"error": message}, request_id=trace.request_id
+                    code, {"error": message}, request_id=trace.request_id,
+                    headers=headers,
                 )
             finally:
                 trace.add_phase("respond", t0, time.perf_counter())
@@ -298,8 +396,51 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                     slo_tracker.observe(trace.total_s, ok=False)
                 recorder.record(trace)
 
+        def _post_faults(self) -> None:
+            """POST /debug/faults: arm/disarm/reset the injection registry
+            over HTTP (the chaos driver's control plane). Guarded — see
+            ``faults.enable_endpoint``."""
+            if not faults.endpoint_enabled():
+                self.close_connection = True
+                self._json(403, {
+                    "error": "fault-injection endpoint disabled "
+                    "(start serve with --inject or --fault-endpoint)",
+                })
+                return
+            try:
+                length = int(self.headers.get("Content-Length", ""))
+            except ValueError:
+                length = -1
+            if length < 0 or length > self.max_body_bytes:
+                self.close_connection = True
+                self._json(400, {"error": "missing or oversized body"})
+                return
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError("body must be a JSON object")
+                if "arm" in req:
+                    faults.arm(str(req["arm"]))
+                elif "disarm" in req:
+                    faults.disarm(str(req["disarm"]))
+                elif req.get("reset"):
+                    faults.reset()
+                else:
+                    raise ValueError(
+                        'expected {"arm": SPEC}, {"disarm": SITE}, '
+                        'or {"reset": true}'
+                    )
+            except (ValueError, json.JSONDecodeError) as exc:
+                self._json(400, {"error": str(exc)})
+                return
+            self._json(200, faults.snapshot())
+
         def do_POST(self) -> None:  # noqa: N802 (http.server API)
-            if urlparse(self.path).path != "/predict":
+            path = urlparse(self.path).path
+            if path == "/debug/faults":
+                self._post_faults()
+                return
+            if path != "/predict":
                 # Unread body on a keep-alive connection would be parsed
                 # as the NEXT request line — close instead of desyncing.
                 self.close_connection = True
@@ -318,6 +459,15 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                     self.headers.get("X-Request-Id")
                 )
             )
+            try:
+                # Faultpoint at admission, before the body is touched: an
+                # injected parse fault replies an explicit 500 (body
+                # unread, so the connection closes instead of desyncing).
+                faults.fire("server.parse")
+            except faults.InjectedFault as exc:
+                self.close_connection = True
+                self._fail(trace, "error", 500, str(exc))
+                return
             try:
                 length = int(self.headers.get("Content-Length", ""))
             except ValueError:
@@ -352,6 +502,23 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                 )
                 return
             trace.add_phase("parse", trace.t_start, time.perf_counter())
+            # Degraded mode: while the breaker is open the engine cannot
+            # answer, so shed HERE — an explicit 503 with a Retry-After
+            # derived from the restart schedule — instead of admitting
+            # into a queue that can only fail or time the client out.
+            if getattr(engine, "breaker_open", False):
+                # Both shed families move, once each: serve_shed_total is
+                # THE shed-rate metric (overload + degraded alike — same
+                # explicit-503 contract), resilience_degraded_sheds_total
+                # attributes the degraded subset.
+                metrics.shed_total.inc()
+                DEGRADED_SHEDS.inc()
+                trace.note(shed=True, degraded=True)
+                self._fail(
+                    trace, "shed", 503, "degraded: engine restarting",
+                    headers=_retry_after(engine.retry_after_s()),
+                )
+                return
             try:
                 future = batcher.submit(row[0], trace=trace)
             except Overloaded:
@@ -388,6 +555,22 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
                 trace.finish("timeout", error=msg)
                 self._fail(trace, "timeout", 504, msg)
                 return
+            except BreakerOpen as exc:
+                # The breaker opened after this request was admitted (its
+                # flush ran while degraded): same explicit shed contract
+                # as the pre-admission check.
+                DEGRADED_SHEDS.inc()
+                trace.note(shed=True, degraded=True)
+                self._fail(
+                    trace, "shed", 503, str(exc),
+                    headers=_retry_after(exc.retry_after_s),
+                )
+                return
+            except ComputeDeadlineExceeded as exc:
+                # The watchdog abandoned a wedged compute: the request is
+                # dead in bounded time — 504, never a hang.
+                self._fail(trace, "timeout", 504, str(exc))
+                return
             except Exception as exc:
                 self._fail(trace, "error", 500, str(exc))
                 return
@@ -398,6 +581,21 @@ def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
             # raise, and the request must still reach the SLO tracker
             # and the flight recorder (the engine did serve it).
             t_resp0 = trace.phase_end("device_compute", time.perf_counter())
+            try:
+                # Faultpoint on the respond path: an injected fault here
+                # drops the connection with NOTHING written — the client
+                # sees an explicit transport error. A partial/garbled 200
+                # body would be the one unforgivable failure mode (a
+                # wrong answer); a dead socket is not.
+                faults.fire("server.respond")
+            except faults.InjectedFault as exc:
+                self.close_connection = True
+                trace.add_phase("respond", t_resp0, time.perf_counter())
+                trace.finish("error", error=str(exc))
+                if slo_tracker is not None:
+                    slo_tracker.observe(trace.total_s, ok=False)
+                recorder.record(trace)
+                return
             try:
                 self._json(200, {
                     "probability": prob,
@@ -441,6 +639,12 @@ def make_server(
     drift_warn_psi: float = qualitymod.DEFAULT_WARN_PSI,
     drift_alert_psi: float = qualitymod.DEFAULT_ALERT_PSI,
     quality_window: int = 2048,
+    supervise: bool = True,
+    flush_deadline_s: float = 20.0,
+    breaker_failures: int = 3,
+    restart_backoff_s: float = 0.5,
+    restart_backoff_max_s: float = 30.0,
+    fault_endpoint: bool = False,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
@@ -466,6 +670,16 @@ def make_server(
     (``quality_*``), ``/debug/quality``, and ``/healthz``. Without one,
     quality monitoring is simply off (``/healthz`` says ``disabled``) —
     pre-profile checkpoints keep serving.
+
+    Resilience (``resilience.supervisor``, docs/RESILIENCE.md): with
+    ``supervise`` (the default) the engine runs behind a watchdog
+    (``flush_deadline_s`` per flush) and a circuit breaker
+    (``breaker_failures`` consecutive failures, or one wedged compute,
+    open it); while open, ``/predict`` sheds 503 + ``Retry-After`` and a
+    supervised restart rebuilds + re-warms the engine under bounded
+    exponential backoff (``restart_backoff_s``..``restart_backoff_max_s``).
+    ``fault_endpoint`` opts the process into ``/debug/faults`` chaos
+    control (``resilience.faults``).
 
     The listener BINDS before warmup runs: a port conflict fails in
     milliseconds instead of after the multi-second compile bill. Warmup
@@ -528,9 +742,32 @@ def make_server(
                 window=quality_window,
                 feature_names=feature_names,
             )
+    if fault_endpoint:
+        faults.enable_endpoint()
     engine = BucketedPredictEngine(
         params, buckets=buckets, quality=quality_monitor
     )
+    if supervise:
+        engine_buckets = engine.buckets
+
+        def rebuild_engine():
+            # Restart path (supervisor thread, off the request path):
+            # fresh jit cache, ALWAYS re-warmed — a restarted engine that
+            # made the first post-recovery requests pay the compile bill
+            # would turn recovery into a tail-latency incident.
+            eng = BucketedPredictEngine(
+                params, buckets=engine_buckets, quality=quality_monitor
+            )
+            eng.warmup(say=say)
+            return eng
+
+        engine = SupervisedEngine(
+            engine, rebuild_engine,
+            flush_deadline_s=flush_deadline_s,
+            breaker_failures=breaker_failures,
+            restart_backoff_s=restart_backoff_s,
+            restart_backoff_max_s=restart_backoff_max_s,
+        )
     metrics = ServingMetrics(batch_buckets=engine.buckets)
     batcher = MicroBatcher(
         engine,
@@ -566,6 +803,9 @@ def make_server(
             engine.warmup(say=say)
     except BaseException:
         batcher.close(drain=False, timeout=1.0)
+        close_engine = getattr(engine, "close", None)
+        if close_engine is not None:
+            close_engine()
         if handle.httpd is not None:
             # The listener bound before warmup failed: release the port so
             # a caller that catches and retries doesn't hit EADDRINUSE.

@@ -362,6 +362,11 @@ def test_profile_less_checkpoint_loads_with_single_journaled_warning(
     assert sidecar["root"]["fields"]["quality"] == {"static": None}
     del sidecar["root"]["fields"]["quality"]
     sc_path.write_text(_json.dumps(sidecar))
+    # An old build wrote no integrity manifest either — and the current
+    # one covers the sidecar, so the edit above would (correctly) read as
+    # corruption. Delete it to reproduce the legacy layout exactly;
+    # manifest-less checkpoints restore unverified by design.
+    (tmp_path / "old_format" / "integrity.json").unlink()
 
     jrn = journal.RunJournal(tmp_path / "restore.jsonl", command="predict")
     journal.set_journal(jrn)

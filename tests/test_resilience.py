@@ -1,0 +1,938 @@
+"""Resilience layer (resilience/): deterministic fault injection, the
+supervised engine (watchdog / circuit breaker / backoff restart), checkpoint
+integrity + last-known-good rollback, degraded-mode serving, and graceful
+drain under adversity.
+
+The acceptance contract (ISSUE 5): under every injected fault class a
+client receives either a correct answer or an explicit shed — never a
+wrong answer, never a hang — with every breaker/rollback transition
+journaled and exported as ``resilience_*`` / ``fault_injected_total``
+metrics that pass the strict exposition validator. ``tools/chaos_drill.py``
+drives the same matrix as a standalone artifact-producing drill; these
+tests pin the semantics piece by piece, CPU-only, under the tier-1 marker
+set.
+"""
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.data.examples import (
+    EXAMPLE_PATIENT,
+    patient_row,
+)
+from machine_learning_replications_tpu.obs import journal
+from machine_learning_replications_tpu.resilience import faults
+from machine_learning_replications_tpu.resilience import lastgood
+from machine_learning_replications_tpu.resilience.supervisor import (
+    BreakerOpen,
+    ComputeDeadlineExceeded,
+    SupervisedEngine,
+)
+from machine_learning_replications_tpu.serve import make_server
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The fault registry is process-global by design; tests must not leak
+    armed sites into each other (tier-1 runs with -p no:randomly, but the
+    hygiene must not depend on it)."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def run_journal(tmp_path):
+    """An active journal for the duration of one test; yields its path."""
+    jrn = journal.RunJournal(tmp_path / "journal.jsonl", command="test")
+    journal.set_journal(jrn)
+    yield jrn.path
+    journal.set_journal(None)
+    jrn.close()
+
+
+def _events(path, kind=None):
+    with open(path) as f:
+        evs = [json.loads(line) for line in f]
+    return [e for e in evs if kind is None or e.get("kind") == kind]
+
+
+@pytest.fixture(scope="module")
+def stacking_params():
+    """Tiny sklearn-imported stacking ensemble (same import route as the
+    shipped pickle; small enough to warm in a couple of seconds)."""
+    from sklearn.ensemble import (
+        GradientBoostingClassifier, StackingClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    from machine_learning_replications_tpu.persist import import_stacking
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(80, 17))
+    y = (X @ rng.normal(size=17) > 0).astype(float)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = StackingClassifier(
+            estimators=[
+                ("svc", make_pipeline(
+                    StandardScaler(), SVC(probability=True, random_state=0))),
+                ("gbc", GradientBoostingClassifier(
+                    n_estimators=3, max_depth=1, random_state=0)),
+                ("lg", LogisticRegression()),
+            ],
+            final_estimator=LogisticRegression(),
+        ).fit(X, y)
+    return import_stacking(clf)
+
+
+# ---------------------------------------------------------------------------
+# faults: spec grammar, schedules, registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_spec_grammar_roundtrip():
+    for text in (
+        "engine.compute:raise",
+        "engine.compute:raise@n=3",
+        "batcher.flush:delay=0.5@p=0.25,seed=7",
+        "persist.restore:corrupt@once",
+        "persist.save:corrupt@count=2",
+    ):
+        spec = faults.parse_spec(text)
+        # describe() is the canonical rendering; re-parsing it must be a
+        # fixed point (the journal records describe() strings).
+        again = faults.parse_spec(spec.describe())
+        assert again.describe() == spec.describe()
+
+
+@pytest.mark.parametrize("bad", [
+    "engine.compute",                 # no mode
+    "nosuch.site:raise",              # unknown site
+    "engine.compute:corrupt",         # corrupt unsupported at this site
+    "engine.compute:delay",           # delay without seconds
+    "engine.compute:raise=5",         # raise takes no arg
+    "engine.compute:raise@n=0",       # nth < 1
+    "engine.compute:raise@p=1.5",     # p out of range
+    "engine.compute:raise@n=2,p=0.5",  # n and p exclusive
+    "engine.compute:raise@bogus=1",   # unknown option
+])
+def test_spec_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_fire_unarmed_is_noop_and_returns_false():
+    assert faults.fire("engine.compute") is False
+    assert faults.snapshot()["armed"] == {}
+
+
+def test_raise_schedule_nth_fires_exactly_once():
+    faults.arm("engine.compute:raise@n=3")
+    faults.fire("engine.compute")
+    faults.fire("engine.compute")
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("engine.compute")
+    # @n self-disarms after its single firing: call 4+ is clean
+    assert faults.fire("engine.compute") is False
+    assert faults.snapshot()["armed"] == {}
+
+
+def test_count_schedule_and_snapshot_counts():
+    faults.arm("engine.compute:raise@count=2")
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("engine.compute")
+    assert faults.fire("engine.compute") is False
+    faults.arm("batcher.flush:delay=0.001")
+    faults.fire("batcher.flush")
+    snap = faults.snapshot()
+    assert snap["armed"]["batcher.flush"]["fires"] == 1
+    assert faults.disarm("batcher.flush") is True
+    assert faults.disarm("batcher.flush") is False
+
+
+def test_probability_schedule_is_seed_deterministic():
+    def firing_pattern(seed, n=40):
+        faults.arm(f"persist.restore:corrupt@p=0.5,seed={seed}")
+        pattern = [faults.fire("persist.restore") for _ in range(n)]
+        faults.reset()
+        return pattern
+
+    a, b = firing_pattern(7), firing_pattern(7)
+    assert a == b, "same seed must replay the same schedule"
+    assert any(a) and not all(a)
+    assert firing_pattern(8) != a  # and the seed actually matters
+
+
+def test_firing_is_journaled_and_counted(run_journal):
+    before = faults.FAULTS_INJECTED.labels(site="engine.warmup").value
+    faults.arm("engine.warmup:raise@once")
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("engine.warmup")
+    assert faults.FAULTS_INJECTED.labels(
+        site="engine.warmup").value == before + 1
+    fired = _events(run_journal, "fault_injected")
+    assert fired and fired[-1]["site"] == "engine.warmup"
+    assert _events(run_journal, "fault_armed")
+
+
+# ---------------------------------------------------------------------------
+# supervisor: watchdog, breaker, restart, quality re-enable
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedEngine:
+    """Engine double whose predict follows a script of 'ok' | 'fail' |
+     'wedge' actions (repeating the last action when exhausted)."""
+
+    def __init__(self, script, quality=None):
+        self.script = list(script)
+        self.calls = 0
+        self.quality = quality
+        self.params = object()
+        self.buckets = (1, 8)
+        self.warm = True
+        self.n_features = 17
+        self.trace_counts = {}
+
+    def predict(self, X):
+        action = self.script[min(self.calls, len(self.script) - 1)]
+        self.calls += 1
+        if action == "fail":
+            raise RuntimeError("scripted failure")
+        if action == "wedge":
+            time.sleep(3.0)
+        return np.asarray(X).mean(axis=1)
+
+    def bucket_for(self, n):
+        return 8
+
+    def compile_count(self):
+        return 0
+
+    def warmup(self, say=None):
+        return {}
+
+
+def _supervised(script, factory_script=("ok",), **kw):
+    made = []
+
+    def factory():
+        eng = _ScriptedEngine(factory_script)
+        made.append(eng)
+        return eng
+
+    sup = SupervisedEngine(
+        _ScriptedEngine(script), factory,
+        flush_deadline_s=kw.pop("flush_deadline_s", 1.0),
+        breaker_failures=kw.pop("breaker_failures", 2),
+        restart_backoff_s=kw.pop("restart_backoff_s", 0.05),
+        restart_backoff_max_s=kw.pop("restart_backoff_max_s", 0.2),
+        **kw,
+    )
+    return sup, made
+
+
+def _wait(pred, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_breaker_opens_after_consecutive_failures_then_recovers(run_journal):
+    sup, made = _supervised(["fail"])
+    X = np.zeros((2, 17))
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="scripted"):
+            sup.predict(X)
+    assert sup.breaker_open
+    snap = sup.snapshot()
+    assert snap["state"] == "open" and "consecutive" in snap["open_reason"]
+    # While open: instant explicit shed, with a positive Retry-After
+    with pytest.raises(BreakerOpen):
+        sup.predict(X)
+    assert sup.retry_after_s() >= 1.0
+    # The restarter swaps in the factory's healthy engine and closes
+    _wait(lambda: not sup.breaker_open, what="breaker close")
+    assert made, "factory was never called"
+    out = sup.predict(X)
+    assert out.shape == (2,)
+    kinds = [e["kind"] for e in _events(run_journal)]
+    assert "breaker_open" in kinds and "breaker_close" in kinds
+    restarts = _events(run_journal, "engine_restart")
+    assert restarts and restarts[-1]["ok"] is True
+    sup.close()
+
+
+def test_single_failure_below_threshold_does_not_trip():
+    sup, _ = _supervised(["fail", "ok"], breaker_failures=2)
+    X = np.zeros((1, 17))
+    with pytest.raises(RuntimeError):
+        sup.predict(X)
+    assert not sup.breaker_open
+    # success resets the streak; a later single failure still doesn't trip
+    sup.predict(X)
+    assert sup.snapshot()["fail_streak"] == 0
+    sup.close()
+
+
+def test_watchdog_abandons_wedged_compute_in_bounded_time(run_journal):
+    sup, _ = _supervised(["wedge"], flush_deadline_s=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(ComputeDeadlineExceeded):
+        sup.predict(np.zeros((1, 17)))
+    elapsed = time.monotonic() - t0
+    # Explicit failure at the deadline, NOT after the 3 s injected wedge
+    assert elapsed < 1.5, f"watchdog took {elapsed:.2f}s"
+    assert sup.breaker_open
+    opened = _events(run_journal, "breaker_open")
+    assert opened and opened[-1]["wedged"] is True
+    _wait(lambda: not sup.breaker_open, what="recovery after wedge")
+    assert sup.predict(np.zeros((1, 17))).shape == (1,)
+    sup.close()
+
+
+def test_restart_retries_failing_factory_with_bounded_backoff(run_journal):
+    attempts = []
+
+    def flaky_factory():
+        attempts.append(time.monotonic())
+        if len(attempts) < 3:
+            raise RuntimeError("warmup failed (injected)")
+        return _ScriptedEngine(["ok"])
+
+    sup = SupervisedEngine(
+        _ScriptedEngine(["fail"]), flaky_factory,
+        breaker_failures=1, restart_backoff_s=0.05,
+        restart_backoff_max_s=0.2,
+    )
+    with pytest.raises(RuntimeError):
+        sup.predict(np.zeros((1, 17)))
+    _wait(lambda: not sup.breaker_open, what="recovery after flaky factory")
+    assert len(attempts) == 3
+    failed = [
+        e for e in _events(run_journal, "engine_restart") if not e["ok"]
+    ]
+    assert len(failed) == 2
+    # Exponential spacing: the second retry gap is larger than the first
+    # (bounded by the cap; generous slack for scheduler jitter).
+    assert attempts[2] - attempts[1] > (attempts[1] - attempts[0]) * 0.9
+    sup.close()
+
+
+def test_quality_feed_reenabled_after_successful_restart(run_journal):
+    from machine_learning_replications_tpu.obs import quality
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4))
+    scores = rng.uniform(size=300)
+    profile = quality.build_reference_profile(
+        X, scores, (scores > 0.5).astype(float)
+    )
+    from machine_learning_replications_tpu.obs.registry import (
+        MetricsRegistry,
+    )
+
+    monitor = quality.QualityMonitor(
+        profile, window=256, registry=MetricsRegistry()
+    )
+    monitor.disable("feed quarantined: scripted")
+    assert monitor.health()["status"] == "disabled"
+
+    sup, _ = _supervised(["fail"], breaker_failures=1)
+    # The factory's replacement engine carries the (disabled) monitor —
+    # exactly what make_server's rebuild closure does.
+    sup._factory = lambda: _ScriptedEngine(["ok"], quality=monitor)
+    with pytest.raises(RuntimeError):
+        sup.predict(np.zeros((1, 17)))
+    _wait(lambda: not sup.breaker_open, what="restart with quality monitor")
+    assert monitor.health()["status"] != "disabled"
+    reen = _events(run_journal, "quality_feed_reenabled")
+    assert reen and reen[-1]["after"] == "engine_restart"
+    # Idempotence: an enabled monitor reports False, no double journal
+    assert monitor.reenable() is False
+    sup.close()
+
+
+def test_close_stops_an_inflight_restarter():
+    """A supervisor shut down while the breaker is open must stop
+    rebuilding: without the closed flag, the restarter would re-warm
+    engines every backoff interval for the process lifetime."""
+    attempts = []
+
+    def always_failing_factory():
+        attempts.append(time.monotonic())
+        raise RuntimeError("still broken")
+
+    sup = SupervisedEngine(
+        _ScriptedEngine(["fail"]), always_failing_factory,
+        breaker_failures=1, restart_backoff_s=0.05,
+        restart_backoff_max_s=0.05,
+    )
+    with pytest.raises(RuntimeError):
+        sup.predict(np.zeros((1, 17)))
+    _wait(lambda: len(attempts) >= 2, what="restarter spinning")
+    sup.close()
+    time.sleep(0.3)
+    n = len(attempts)
+    time.sleep(0.3)
+    assert len(attempts) == n, "restarter kept rebuilding after close()"
+    assert sup.breaker_open  # closed-while-degraded stays degraded
+
+
+def test_second_supervisor_does_not_mask_open_breaker_gauge():
+    """The breaker-state gauge is process-global; constructing another
+    supervisor (multi-server-per-process, the test suite's own pattern)
+    must not publish a phantom 'closed' over a degraded server."""
+    from machine_learning_replications_tpu.resilience.supervisor import (
+        BREAKER_STATE,
+    )
+
+    def dead_factory():
+        raise RuntimeError("never recovers")
+
+    sup1 = SupervisedEngine(
+        _ScriptedEngine(["fail"]), dead_factory, breaker_failures=1,
+        restart_backoff_s=0.05, restart_backoff_max_s=0.05,
+    )
+    with pytest.raises(RuntimeError):
+        sup1.predict(np.zeros((1, 17)))
+    assert sup1.breaker_open and BREAKER_STATE.get().value == 1.0
+    sup2, _ = _supervised(["ok"])
+    assert BREAKER_STATE.get().value == 1.0, \
+        "second supervisor's construction masked the open breaker"
+    sup1.close()
+    sup2.close()
+    BREAKER_STATE.get().set(0.0)  # restore for later tests
+
+
+def test_inflight_breaker_shed_counts_as_shed_not_engine_error():
+    """Requests admitted just before the breaker opened are SHED when
+    their flush hits BreakerOpen — serve_shed_total, not
+    serve_errors_total (the engine was never invoked)."""
+    from machine_learning_replications_tpu.serve import (
+        MicroBatcher, ServingMetrics,
+    )
+
+    class _OpenEngine:
+        n_features = 17
+
+        def predict(self, X):
+            raise BreakerOpen(1.0)
+
+    m = ServingMetrics()
+    b = MicroBatcher(_OpenEngine(), max_batch_size=4, max_wait_ms=1.0,
+                     max_queue=16, metrics=m)
+    try:
+        futs = [b.submit(np.zeros(17)) for _ in range(3)]
+        for f in futs:
+            with pytest.raises(BreakerOpen):
+                f.result(timeout=5.0)
+        assert m.shed_total.value == 3
+        assert m.errors_total.value == 0
+    finally:
+        b.close(drain=False)
+
+
+def test_supervisor_parameter_validation():
+    eng = _ScriptedEngine(["ok"])
+    with pytest.raises(ValueError):
+        SupervisedEngine(eng, lambda: eng, flush_deadline_s=0)
+    with pytest.raises(ValueError):
+        SupervisedEngine(eng, lambda: eng, breaker_failures=0)
+    with pytest.raises(ValueError):
+        SupervisedEngine(
+            eng, lambda: eng, restart_backoff_s=2.0,
+            restart_backoff_max_s=1.0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity + last-known-good rollback
+# ---------------------------------------------------------------------------
+
+
+def test_save_publishes_integrity_manifest(tmp_path, stacking_params):
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    path = tmp_path / "ckpt"
+    orbax_io.save_model(path, stacking_params)
+    manifest = json.loads((path / "integrity.json").read_text())
+    assert manifest["format"] == 1 and manifest["files"]
+    # The sidecar template is covered too (it is part of the restore path)
+    assert "pytree_template.json" in manifest["files"]
+    assert orbax_io.verify_checkpoint(path) is True
+    # Manifest-less (legacy) checkpoints are tolerated, not verified
+    (path / "integrity.json").unlink()
+    assert orbax_io.verify_checkpoint(path) is False
+    assert orbax_io.load_model(path) is not None
+
+
+def test_corruption_detected_before_orbax_touches_it(tmp_path,
+                                                     stacking_params):
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    path = tmp_path / "ckpt"
+    orbax_io.save_model(path, stacking_params)
+    # Flip one byte of the largest payload file
+    orbax_io._corrupt_payload(str(path))
+    with pytest.raises(orbax_io.CheckpointIntegrityError):
+        orbax_io.load_model(path)  # no lastgood retained -> loud failure
+
+
+def test_corrupt_primary_rolls_back_to_lastgood(tmp_path, run_journal,
+                                                stacking_params):
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    v1 = stacking_params
+    # A distinguishable v2: perturb the meta coefficients
+    v2 = v1.replace(meta=v1.meta.replace(
+        coef=np.asarray(v1.meta.coef) * 1.5
+    ))
+    p1 = float(np.asarray(stacking.predict_proba1(v1, patient_row()))[0])
+    p2 = float(np.asarray(stacking.predict_proba1(v2, patient_row()))[0])
+    assert p1 != p2
+
+    path = tmp_path / "model"
+    orbax_io.save_model(path, v1)
+    orbax_io.save_model(path, v2)  # v1 rotated to lastgood
+    assert os.path.isdir(lastgood.lastgood_path(path))
+    before = lastgood.CHECKPOINT_ROLLBACKS.get().value
+    orbax_io._corrupt_payload(str(path))
+    restored = orbax_io.load_model(path)
+    # The bad deploy degrades to the PREVIOUS model, exactly
+    got = float(np.asarray(
+        stacking.predict_proba1(restored, patient_row()))[0])
+    assert got == p1
+    assert lastgood.CHECKPOINT_ROLLBACKS.get().value == before + 1
+    rb = _events(run_journal, "checkpoint_rollback")
+    assert rb and rb[-1]["path"] == str(path)
+    assert "CheckpointIntegrityError" in rb[-1]["error"]
+
+
+def test_rotten_primary_is_not_rotated_over_good_lastgood(
+    tmp_path, run_journal, stacking_params
+):
+    """A primary that rotted on disk AFTER publish must not replace a
+    genuinely good last-known-good at the next save — that would destroy
+    the rollback net exactly when it is about to be needed. The per-save
+    guard is shallow (size-only — re-hashing the whole previous
+    checkpoint every save would triple checkpoint I/O), so the rot here
+    is a truncation; same-size bit rot is caught by the deep verify every
+    restore runs."""
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    v1 = stacking_params
+    v2 = v1.replace(meta=v1.meta.replace(
+        coef=np.asarray(v1.meta.coef) * 1.5
+    ))
+    p1 = float(np.asarray(stacking.predict_proba1(v1, patient_row()))[0])
+
+    path = tmp_path / "model"
+    orbax_io.save_model(path, v1)
+    orbax_io.save_model(path, v2)            # lastgood = v1 (good)
+    # Truncate the largest payload file: the primary v2 rots on disk
+    biggest = max(
+        (os.path.join(path, rel) for rel in orbax_io._payload_files(path)),
+        key=os.path.getsize,
+    )
+    with open(biggest, "r+b") as f:
+        f.truncate(max(os.path.getsize(biggest) // 2, 1))
+    orbax_io.save_model(path, v2)            # retain must SKIP the rot
+    skipped = _events(run_journal, "checkpoint_retain_skipped")
+    assert skipped and "CheckpointIntegrityError" in skipped[-1]["error"]
+    # The lastgood slot still holds good v1, not the corrupt v2
+    lg = orbax_io.load_model(lastgood.lastgood_path(path))
+    got = float(np.asarray(stacking.predict_proba1(lg, patient_row()))[0])
+    assert got == p1
+
+
+def test_loadgen_retries_rejected_in_open_loop(capsys):
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                     "tools"))
+    try:
+        import loadgen
+    finally:
+        _sys.path.pop(0)
+    with pytest.raises(SystemExit):
+        loadgen.main(["--mode", "open", "--retries", "2"])
+    assert "open loop" in capsys.readouterr().err
+
+
+def test_interrupted_save_leaves_previous_checkpoint_intact(
+    tmp_path, stacking_params
+):
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    path = tmp_path / "model"
+    orbax_io.save_model(path, stacking_params)
+    p_before = float(np.asarray(
+        stacking.predict_proba1(stacking_params, patient_row()))[0])
+    faults.arm("persist.save:raise@once")
+    with pytest.raises(faults.InjectedFault):
+        orbax_io.save_model(path, stacking_params)
+    # The torn publish left no tmp litter and the old checkpoint loads
+    assert not [d for d in os.listdir(tmp_path) if ".tmp." in d]
+    restored = orbax_io.load_model(path)
+    got = float(np.asarray(
+        stacking.predict_proba1(restored, patient_row()))[0])
+    assert got == p_before
+
+
+def test_corrupt_at_save_detected_at_restore(tmp_path, stacking_params):
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    path = tmp_path / "model"
+    faults.arm("persist.save:corrupt@once")
+    orbax_io.save_model(path, stacking_params)  # bytes torn AFTER checksum
+    with pytest.raises(orbax_io.CheckpointIntegrityError):
+        orbax_io.load_model(path)
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode serving over live HTTP
+# ---------------------------------------------------------------------------
+
+
+def _post(url, obj, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read() or b"{}")
+
+
+@pytest.fixture()
+def chaos_server(stacking_params):
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), max_wait_ms=1.0,
+        flush_deadline_s=0.5, breaker_failures=2,
+        restart_backoff_s=0.1, restart_backoff_max_s=0.5,
+    ).start_background()
+    host, port = handle.address
+    yield handle, f"http://{host}:{port}"
+    handle.shutdown()
+
+
+def test_degraded_mode_sheds_503_with_retry_after_then_recovers(
+    chaos_server, run_journal
+):
+    handle, url = chaos_server
+    status, body, _ = _post(url + "/predict", dict(EXAMPLE_PATIENT))
+    golden = body["probability"]
+
+    faults.arm("engine.compute:raise")
+    saw_503_headers = None
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        try:
+            _post(url + "/predict", dict(EXAMPLE_PATIENT))
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            if exc.code == 503:
+                saw_503_headers = dict(exc.headers)
+                break
+            assert exc.code == 500  # pre-breaker failures are explicit
+    assert saw_503_headers is not None, "breaker never opened"
+    ra = saw_503_headers.get("Retry-After")
+    assert ra is not None and int(ra) >= 1
+
+    # Degraded is visible everywhere an orchestrator looks: liveness 200
+    # with status=degraded, readiness 503 naming the breaker.
+    status, health = _get(url + "/healthz")
+    assert status == 200 and health["status"] == "degraded"
+    assert health["ready"] is False
+    assert health["breaker"]["state"] == "open"
+    status, ready = _get(url + "/readyz")
+    assert status == 503 and "degraded: circuit breaker open" in \
+        ready["reasons"]
+
+    faults.reset()
+    deadline = time.monotonic() + 15.0
+    recovered = False
+    while time.monotonic() < deadline:
+        try:
+            status, body, _ = _post(url + "/predict", dict(EXAMPLE_PATIENT))
+            assert body["probability"] == golden  # never a wrong answer
+            recovered = True
+            break
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            time.sleep(0.05)
+    assert recovered, "server never recovered after disarm"
+    status, health = _get(url + "/healthz")
+    assert health["status"] == "ok" and health["ready"] is True
+
+    kinds = [e["kind"] for e in _events(run_journal)]
+    assert "breaker_open" in kinds and "breaker_close" in kinds
+    assert "fault_injected" in kinds
+    sheds = [
+        e for e in _events(run_journal) if e.get("kind") == "breaker_open"
+    ]
+    assert sheds
+
+
+def test_wedged_flush_is_abandoned_not_hung(chaos_server):
+    handle, url = chaos_server
+    faults.arm("engine.compute:delay=3.0@n=1")
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url + "/predict", dict(EXAMPLE_PATIENT))
+    ei.value.read()
+    elapsed = time.monotonic() - t0
+    # 504 at the 0.5 s flush deadline — bounded, NOT the 3 s wedge
+    assert ei.value.code in (503, 504)
+    assert elapsed < 2.5, f"client waited {elapsed:.2f}s"
+    # and the server recovers without a process restart
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        try:
+            status, _, _ = _post(url + "/predict", dict(EXAMPLE_PATIENT))
+            assert status == 200
+            return
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            time.sleep(0.05)
+    raise AssertionError("no recovery after wedge")
+
+
+def test_resilience_families_on_metrics_pass_strict_validator(chaos_server):
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                     "tools"))
+    try:
+        import validate_metrics
+    finally:
+        _sys.path.pop(0)
+    handle, url = chaos_server
+    _post(url + "/predict", dict(EXAMPLE_PATIENT))
+    with urllib.request.urlopen(url + "/metrics", timeout=10.0) as resp:
+        page = resp.read().decode()
+    for family in ("fault_injected_total", "resilience_breaker_state",
+                   "resilience_breaker_transitions_total",
+                   "resilience_engine_restarts_total",
+                   "resilience_watchdog_trips_total",
+                   "resilience_degraded_sheds_total",
+                   "resilience_checkpoint_rollbacks_total"):
+        assert family in page, f"{family} missing"
+    assert validate_metrics.validate(page) == []
+
+
+def test_debug_faults_endpoint_guard_and_control(chaos_server, monkeypatch):
+    handle, url = chaos_server
+    # Guard: without the opt-in, both methods 403 and nothing arms
+    monkeypatch.setattr(faults, "_endpoint_enabled", False)
+    status, body = _get(url + "/debug/faults")
+    assert status == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url + "/debug/faults", {"arm": "engine.compute:raise"})
+    assert ei.value.code == 403
+    ei.value.read()
+    assert faults.snapshot()["armed"] == {}
+
+    monkeypatch.setattr(faults, "_endpoint_enabled", True)
+    status, snap, _ = _post(
+        url + "/debug/faults", {"arm": "batcher.flush:delay=0.001@once"}
+    )
+    assert status == 200 and "batcher.flush" in snap["armed"]
+    status, body = _get(url + "/debug/faults")
+    assert status == 200 and "batcher.flush" in body["armed"]
+    status, snap, _ = _post(url + "/debug/faults",
+                            {"disarm": "batcher.flush"})
+    assert snap["armed"] == {}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url + "/debug/faults", {"arm": "nosuch.site:raise"})
+    assert ei.value.code == 400
+    ei.value.read()
+
+
+def test_readyz_tracks_warmup_drain_and_liveness_split(stacking_params):
+    handle = make_server(
+        stacking_params, port=0, buckets=(1,), warmup=False,
+    ).start_background()
+    try:
+        host, port = handle.address
+        url = f"http://{host}:{port}"
+        # Cold engine: alive (healthz 200, status ok) but NOT ready
+        status, health = _get(url + "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["ready"] is False
+        status, ready = _get(url + "/readyz")
+        assert status == 503 and "warmup incomplete" in ready["reasons"]
+
+        handle.engine.warmup()
+        status, ready = _get(url + "/readyz")
+        assert status == 200 and ready["ready"] is True
+
+        # Draining: readiness drops first so the LB rotates us out while
+        # in-flight work completes
+        handle.draining = True
+        status, ready = _get(url + "/readyz")
+        assert status == 503 and "draining" in ready["reasons"]
+        status, health = _get(url + "/healthz")
+        assert status == 200 and health["draining"] is True
+    finally:
+        handle.shutdown()
+
+
+def test_disarmed_faultpoints_preserve_parity_and_compile_bound(
+    stacking_params
+):
+    """Acceptance: with faults disarmed the hot path is untouched —
+    bit-identical predictions through the supervised engine and the same
+    one-compile-per-bucket bound."""
+    from machine_learning_replications_tpu.serve import (
+        BucketedPredictEngine,
+    )
+
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8))
+    sup = SupervisedEngine(eng, lambda: eng)
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(11, 17))
+    baseline = eng.predict(X)
+    # Arm + fully exhaust a schedule, then compare: the registry must
+    # leave no residue on the compute path
+    faults.arm("engine.compute:raise@n=1")
+    with pytest.raises(faults.InjectedFault):
+        eng.predict(X[:1])
+    np.testing.assert_array_equal(sup.predict(X), baseline)
+    # No extra compiles: the injected raise fired BEFORE the compute, so
+    # the jit cache never even saw the aborted call's bucket
+    assert eng.trace_counts == {8: 1}
+    sup.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain under adversity
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_drain_with_inflight_and_client_disconnect(stacking_params):
+    """The satellite contract: SIGTERM while requests are in flight, plus
+    a client that disconnects mid-drain, completes the drain without
+    losing or double-answering any request. (SIGTERM -> shutdown-thread is
+    the cli serve handler's exact shape.)"""
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), max_wait_ms=1.0,
+        max_queue=64,
+    ).start_background()
+    host, port = handle.address
+    url = f"http://{host}:{port}"
+
+    # Slow the engine so requests are genuinely in flight at SIGTERM
+    real_predict = handle.batcher._engine.predict
+
+    def slow_predict(X):
+        time.sleep(0.25)
+        return real_predict(X)
+
+    handle.batcher._engine = type("Slow", (), {
+        "predict": staticmethod(slow_predict),
+        "bucket_for": staticmethod(handle.engine.bucket_for),
+    })()
+
+    status, body, _ = _post(url + "/predict", dict(EXAMPLE_PATIENT))
+    golden = body["probability"]
+
+    results: list[tuple] = []
+    res_lock = threading.Lock()
+
+    def client(i):
+        try:
+            status, body, _ = _post(
+                url + "/predict", dict(EXAMPLE_PATIENT), timeout=30.0
+            )
+            with res_lock:
+                results.append(("ok", body["probability"]))
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            with res_lock:
+                results.append((f"http_{exc.code}", None))
+        except Exception as exc:
+            with res_lock:
+                results.append((f"err_{type(exc).__name__}", None))
+
+    shutdown_threads: list[threading.Thread] = []
+
+    def on_sigterm(signum, frame):
+        th = threading.Thread(target=handle.shutdown, daemon=True)
+        th.start()
+        shutdown_threads.append(th)
+
+    old = signal.signal(signal.SIGTERM, on_sigterm)
+    try:
+        clients = [
+            threading.Thread(target=client, args=(i,)) for i in range(6)
+        ]
+        for t in clients:
+            t.start()
+        time.sleep(0.1)  # let them reach the (slow) batcher
+
+        # The adversarial client: sends a full request, hangs up before
+        # the reply — mid-drain its write will fail server-side.
+        raw = socket.create_connection((host, port), timeout=5.0)
+        payload = json.dumps(dict(EXAMPLE_PATIENT)).encode()
+        raw.sendall(
+            b"POST /predict HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(payload)).encode()
+            + b"\r\n\r\n" + payload
+        )
+        time.sleep(0.05)
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)
+        raw.close()  # the mid-drain disconnect
+
+        for t in clients:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "a client hung through the drain"
+        for th in shutdown_threads:
+            th.join(timeout=30.0)
+            assert not th.is_alive(), "shutdown (drain) never completed"
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        handle.shutdown()  # idempotent
+
+    # Exactly one reply per surviving client; every admitted request
+    # either answered correctly or failed explicitly (shed at admission
+    # close) — nothing lost, nothing double-answered, nothing wrong.
+    assert len(results) == 6
+    for kind, prob in results:
+        if kind == "ok":
+            assert prob == golden
+        else:
+            assert kind in ("http_503",), f"unexpected outcome {kind}"
+    assert sum(1 for k, _ in results if k == "ok") >= 1

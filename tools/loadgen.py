@@ -22,6 +22,13 @@ overload reply), or error. The artifact records offered/achieved qps,
 ok/shed/error counts, shed rate, and ok-latency quantiles — the serving
 counterpart of BENCH_*.json.
 
+``--retries N`` makes each worker a *patient* client: a 503 shed is
+retried up to N times under capped exponential backoff with jitter,
+honoring the server's ``Retry-After`` (the degraded-mode contract —
+docs/RESILIENCE.md). Retry counts and give-ups land in the artifact, so a
+chaos bench can state client-visible impact as "K sheds absorbed by
+retry, M abandoned" instead of a raw shed rate.
+
 ``--perturb SPEC`` exercises the server's model-quality monitoring
 (``obs.quality``, ``/debug/quality``) end-to-end: from ``--perturb-at``
 (fraction of the run, default 0.5) onward, every outgoing patient has the
@@ -51,6 +58,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import re
 import sys
 import threading
@@ -180,6 +188,8 @@ class _Tally:
         self.n_ok = 0
         self.n_shed = 0
         self.n_err = 0
+        self.n_retries = 0   # 503 replies retried after backoff
+        self.n_gaveup = 0    # logical requests still shed after max retries
         self.n_worst = n_worst
         # (latency_ms, request_id, status) for every id-carrying reply;
         # reduced to the n_worst slowest at artifact time. One tuple per
@@ -214,35 +224,111 @@ class _Tally:
         ]
 
 
-def _fire(url: str, bodies: _Bodies, timeout: float, tally: _Tally) -> None:
-    req = urllib.request.Request(
-        url + "/predict", data=bodies.next_body(),
-        headers={"Content-Type": "application/json"},
-    )
+class _RetryPolicy:
+    """503-shed retry: capped exponential backoff with jitter, honoring the
+    server's ``Retry-After``. Only explicit sheds retry — a 500/504 is a
+    served answer about THIS request, and blind re-sends of those would
+    double-count against a degraded server. Chaos benches use this to
+    quantify client-visible impact: how many sheds a patient client rides
+    out (``retries``) vs abandons (``give_ups``)."""
+
+    def __init__(self, retries: int = 0, base_ms: float = 100.0,
+                 cap_ms: float = 5000.0, seed: int = 0) -> None:
+        self.retries = int(retries)
+        self.base_ms = float(base_ms)
+        self.cap_ms = float(cap_ms)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def sleep_s(self, attempt: int, retry_after: str | None) -> float:
+        backoff_ms = min(self.cap_ms, self.base_ms * (2 ** attempt))
+        with self._lock:
+            # Full jitter on the backoff half (decorrelates a thundering
+            # herd of shed clients) …
+            jittered_ms = backoff_ms * (0.5 + self._rng.random())
+        try:
+            ra_s = float(retry_after) if retry_after else 0.0
+        except ValueError:
+            ra_s = 0.0
+        # … but never retry BEFORE the server's Retry-After: honoring it
+        # is the point of the header.
+        return max(ra_s, jittered_ms / 1000.0)
+
+    def describe(self) -> dict | None:
+        if self.retries <= 0:
+            return None
+        return {
+            "max_retries": self.retries,
+            "base_ms": self.base_ms,
+            "cap_ms": self.cap_ms,
+        }
+
+
+_NO_RETRY = _RetryPolicy(0)
+
+
+def _fire(
+    url: str, bodies: _Bodies, timeout: float, tally: _Tally,
+    retry: _RetryPolicy = _NO_RETRY, stop_at: float | None = None,
+) -> None:
+    body = bodies.next_body()  # one patient for every attempt of the request
+    attempt = 0
+    # Latency is measured from the FIRST attempt: a request that rode out
+    # three sheds and two seconds of backoff before its 200 took the
+    # client that whole time — recording only the final attempt would
+    # make a degraded window look latency-free in the artifact.
     t0 = time.monotonic()
-    rid = None
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            resp.read()
-            rid = resp.headers.get("X-Request-Id")
-            status = "ok" if resp.status == 200 else "err"
-    except urllib.error.HTTPError as exc:
-        exc.read()
-        rid = exc.headers.get("X-Request-Id")
-        status = "shed" if exc.code == 503 else "err"
-    except Exception:
-        status = "err"
-    tally.record(status, (time.monotonic() - t0) * 1000.0, rid)
+    while True:
+        req = urllib.request.Request(
+            url + "/predict", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        rid = retry_after = None
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                resp.read()
+                rid = resp.headers.get("X-Request-Id")
+                status = "ok" if resp.status == 200 else "err"
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            rid = exc.headers.get("X-Request-Id")
+            retry_after = exc.headers.get("Retry-After")
+            status = "shed" if exc.code == 503 else "err"
+        except Exception:
+            status = "err"
+        latency_ms = (time.monotonic() - t0) * 1000.0
+        if status == "shed" and attempt < retry.retries:
+            sleep_s = retry.sleep_s(attempt, retry_after)
+            # Retries respect the run deadline: a backoff (Retry-After
+            # can be tens of seconds under a slow restart schedule) that
+            # would sleep past --duration becomes a give-up, or workers
+            # could overrun the window by minutes and skew wall/qps.
+            if stop_at is not None and time.monotonic() + sleep_s > stop_at:
+                with tally.lock:
+                    tally.n_gaveup += 1
+                tally.record(status, latency_ms, rid)
+                return
+            with tally.lock:
+                tally.n_retries += 1
+            time.sleep(sleep_s)
+            attempt += 1
+            continue
+        if status == "shed" and retry.retries > 0:
+            with tally.lock:
+                tally.n_gaveup += 1
+        tally.record(status, latency_ms, rid)
+        return
 
 
-def run_closed(url, bodies, duration, concurrency, timeout, tally):
+def run_closed(url, bodies, duration, concurrency, timeout, tally,
+               retry=_NO_RETRY):
     t0 = time.monotonic()
     bodies.arm(t0)
     stop = t0 + duration
 
     def worker():
         while time.monotonic() < stop:
-            _fire(url, bodies, timeout, tally)
+            _fire(url, bodies, timeout, tally, retry=retry, stop_at=stop)
 
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     for t in threads:
@@ -253,6 +339,8 @@ def run_closed(url, bodies, duration, concurrency, timeout, tally):
 
 
 def run_open(url, bodies, duration, qps, timeout, tally):
+    # No retry plumbing on purpose: the CLI rejects --retries in open
+    # mode (a backing-off generator no longer offers its fixed rate).
     """Fixed-rate schedule; each request gets its own thread so a slow
     server cannot throttle the offered rate (the point of an open loop).
     A bound on in-flight threads keeps a wedged server from spawning
@@ -314,12 +402,34 @@ def main(argv=None) -> int:
         help="fraction of the run after which --perturb activates "
         "(default 0.5; 0 perturbs from the first request)",
     )
+    ap.add_argument(
+        "--retries", type=int, default=0,
+        help="max retries per request on a 503 shed (capped exponential "
+        "backoff + jitter, honoring Retry-After); retry counts and "
+        "give-ups land in the artifact — chaos benches quantify "
+        "client-visible impact with this. Closed loop only: a backing-off "
+        "open loop no longer offers its fixed rate",
+    )
+    ap.add_argument(
+        "--retry-base-ms", type=float, default=100.0,
+        help="initial retry backoff (doubles per attempt)",
+    )
+    ap.add_argument(
+        "--retry-cap-ms", type=float, default=5000.0,
+        help="retry backoff cap",
+    )
     ap.add_argument("--out", default=None, help="artifact path (JSON)")
     args = ap.parse_args(argv)
     if args.patient and args.patients:
         ap.error("--patient and --patients are mutually exclusive")
     if not 0.0 <= args.perturb_at <= 1.0:
         ap.error("--perturb-at must be in [0, 1]")
+    if args.retries and args.mode == "open":
+        # A generator that backs off is no longer offering a fixed rate:
+        # retry sleeps would hold in-flight slots and silently throttle
+        # the offered qps the open loop exists to guarantee.
+        ap.error("--retries requires --mode closed (an open loop that "
+                 "backs off is no longer an open loop)")
 
     if args.patients:
         with open(args.patients) as f:
@@ -347,11 +457,15 @@ def main(argv=None) -> int:
     perturb_ops = parse_perturb(args.perturb) if args.perturb else []
     bodies = _Bodies(patients, perturb_ops, args.perturb_at, args.duration)
 
+    retry = _RetryPolicy(
+        retries=args.retries, base_ms=args.retry_base_ms,
+        cap_ms=args.retry_cap_ms,
+    )
     tally = _Tally()
     if args.mode == "closed":
         wall = run_closed(
             args.url, bodies, args.duration, args.concurrency, args.timeout,
-            tally,
+            tally, retry=retry,
         )
         offered = None
     else:
@@ -379,6 +493,14 @@ def main(argv=None) -> int:
             for k, v in _percentiles(tally.ok_latency_ms).items()
         },
         "worst_requests": tally.worst_requests(),
+        # Client-side resilience: how many sheds the retry policy absorbed
+        # (n_shed counts only FINAL sheds — each one a give-up when
+        # retries were on). Null when retries are disabled.
+        "retry": None if retry.describe() is None else {
+            **retry.describe(),
+            "retries": tally.n_retries,
+            "give_ups": tally.n_gaveup,
+        },
         "patients": patients_src,
         "n_patients": len(patients),
         "perturb": bodies.describe(),
