@@ -94,13 +94,18 @@ def _build_mesh(args):
 
 
 @contextlib.contextmanager
-def _observed(args, command: str, config_json: str | None = None):
+def _observed(
+    args, command: str, config_json: str | None = None,
+    manifest_extra: dict | None = None,
+):
     """Stand up the obs layer for one CLI run (docs/OBSERVABILITY.md):
     jax.monitoring accounting into the global registry, an active tracer
     when ``--trace-dir`` is given (Perfetto-loadable ``trace.json`` written
     on exit), an active journal when ``--journal`` is given (manifest
     first, then structured events, ``run_done``/``run_error`` last), and a
-    root span named after the command so every stage nests under it."""
+    root span named after the command so every stage nests under it.
+    ``manifest_extra`` lands in the journal manifest — multi-worker serve
+    stamps its worker id there so per-worker journals stay attributable."""
     from machine_learning_replications_tpu.obs import jaxmon, journal, spans
 
     tracer = jrn = None
@@ -111,7 +116,8 @@ def _observed(args, command: str, config_json: str | None = None):
     # stale global absorbing later spans in in-process callers.
     if getattr(args, "journal", None):
         jrn = journal.RunJournal(
-            args.journal, command=command, config_json=config_json
+            args.journal, command=command, config_json=config_json,
+            extra=manifest_extra,
         )
     if getattr(args, "trace_dir", None):
         tracer = spans.Tracer(process_name=f"mlr-tpu {command}")
@@ -259,9 +265,14 @@ def _run_predict(args) -> int:
 
 def cmd_serve(args) -> int:
     """Micro-batched HTTP inference serving (docs/SERVING.md)."""
+    worker_id = getattr(args, "_worker_id", None)
+    if args.workers > 1 and worker_id is None:
+        return _run_multiworker(args)
     buckets = tuple(int(b) for b in args.buckets.split(","))
     # The serve "config" for the manifest's config_hash: the knobs that
-    # shape serving behavior, deterministically serialized.
+    # shape serving behavior, deterministically serialized. The worker id
+    # is NOT part of it — all workers of one deployment share a config
+    # hash; identity rides the manifest extra instead.
     serve_cfg = json.dumps({
         "buckets": list(buckets), "max_batch": args.max_batch,
         "max_wait_ms": args.max_wait_ms, "max_queue": args.max_queue,
@@ -287,9 +298,108 @@ def cmd_serve(args) -> int:
         # The journaled audit record must state the ACTUAL exposure:
         # --inject implies the endpoint too.
         "fault_endpoint": bool(args.inject or args.fault_endpoint),
+        "workers": args.workers,
+        "idle_timeout_s": args.idle_timeout,
+        "max_connections": args.max_connections,
     }, sort_keys=True)
-    with _observed(args, "serve", config_json=serve_cfg):
+    extra = (
+        {"worker": worker_id, "workers": args.workers}
+        if worker_id is not None else None
+    )
+    with _observed(args, "serve", config_json=serve_cfg,
+                   manifest_extra=extra):
         return _run_serve(args, buckets)
+
+
+def _run_multiworker(args) -> int:
+    """Pre-fork ``SO_REUSEPORT`` multi-worker serving: fork N children
+    BEFORE anything touches jax (a forked initialized backend is
+    undefined behavior), each binding the same port with ``SO_REUSEPORT``
+    and running the full single-worker stack — engine-per-worker over the
+    shared on-disk checkpoint. The parent only supervises: it forwards
+    SIGTERM/SIGINT (each worker drains gracefully) and tears the fleet
+    down if any worker dies unexpectedly, so a half-dead deployment never
+    lingers. Per-worker journals get a ``.wK`` suffix and carry the
+    worker id in their manifest; ``/metrics`` carries
+    ``serve_worker_info{worker=K}``."""
+    import signal
+
+    if args.port == 0:
+        # Port 0 would give every worker a DIFFERENT ephemeral port;
+        # SO_REUSEPORT sharding needs one concrete shared port.
+        raise SystemExit("--workers requires a fixed --port (not 0): "
+                         "all workers bind the same SO_REUSEPORT port")
+    children: list[int] = []
+    for k in range(args.workers):
+        pid = os.fork()
+        if pid == 0:
+            # Child: become worker k and run the normal serve path. Exit
+            # via os._exit — a worker must never fall back into the
+            # parent's supervision loop below.
+            rc = 1
+            try:
+                args._worker_id = k
+                if args.journal:
+                    args.journal = f"{args.journal}.w{k}"
+                if args.trace_dir:
+                    args.trace_dir = os.path.join(args.trace_dir, f"w{k}")
+                rc = cmd_serve(args)
+            except SystemExit as exc:
+                rc = exc.code if isinstance(exc.code, int) else 1
+            except BaseException:
+                import traceback
+
+                traceback.print_exc()
+                rc = 1
+            finally:
+                os._exit(rc or 0)
+        children.append(pid)
+    print(
+        f"serving with {args.workers} SO_REUSEPORT workers on port "
+        f"{args.port} (pids {children})",
+        file=sys.stderr,
+    )
+
+    shutting_down = False
+
+    def _forward(signum, frame):
+        nonlocal shutting_down
+        shutting_down = True
+        for pid in children:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+    rc = 0
+    alive = set(children)
+    while alive:
+        try:
+            pid, status = os.waitpid(-1, 0)
+        except InterruptedError:
+            continue  # a forwarded signal interrupted the wait
+        except ChildProcessError:
+            break
+        if pid not in alive:
+            continue
+        alive.discard(pid)
+        code = (
+            os.WEXITSTATUS(status) if os.WIFEXITED(status)
+            else 128 + os.WTERMSIG(status)
+        )
+        rc = max(rc, code)
+        if code != 0 and not shutting_down and alive:
+            # One worker died outside a deliberate shutdown: take the
+            # rest down too — a silently shrunken fleet would serve at
+            # reduced capacity while looking healthy from the port.
+            print(
+                f"worker pid {pid} exited {code}; stopping the fleet",
+                file=sys.stderr,
+            )
+            _forward(None, None)
+    return rc
 
 
 def _run_serve(args, buckets) -> int:
@@ -346,12 +456,31 @@ def _run_serve(args, buckets) -> int:
         restart_backoff_s=args.restart_backoff_s,
         restart_backoff_max_s=args.restart_backoff_max_s,
         fault_endpoint=bool(args.inject or args.fault_endpoint),
+        idle_timeout_s=args.idle_timeout,
+        max_connections=args.max_connections,
+        # Multi-worker mode: every worker binds the same port with
+        # SO_REUSEPORT; the kernel spreads connections across them.
+        reuse_port=args.workers > 1,
+        worker_id=getattr(args, "_worker_id", None),
     )
+    # Serving-process GC hygiene (the Instagram pre-fork trick): the
+    # warm startup heap — jax, XLA executables, the uploaded ensemble —
+    # is permanent, and leaving it inside the collector's world makes
+    # every gen-2 pass crawl millions of immortal objects mid-traffic.
+    # Freeze it out once, after warmup built everything.
+    import gc
+
+    gc.collect()
+    gc.freeze()
+
     host, port = handle.address
+    wid = getattr(args, "_worker_id", None)
     print(
         f"serving {type(params).__name__} on http://{host}:{port} "
         f"(buckets {buckets}, max_wait {args.max_wait_ms}ms, "
-        f"queue bound {args.max_queue})",
+        f"queue bound {args.max_queue}"
+        + (f", worker {wid}/{args.workers}" if wid is not None else "")
+        + ")",
         file=sys.stderr,
     )
 
@@ -540,6 +669,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-warmup", action="store_true",
         help="skip the startup compile of every bucket (first requests "
         "then pay the XLA compiles)",
+    )
+    v.add_argument(
+        "--workers", type=int, default=1,
+        help="pre-fork N worker processes, each binding the same port "
+        "with SO_REUSEPORT and running its own event loop + engine over "
+        "the shared on-disk checkpoint (requires a fixed --port; "
+        "docs/SERVING.md 'Transport architecture')",
+    )
+    v.add_argument(
+        "--idle-timeout", type=float, default=5.0,
+        help="seconds a keep-alive connection may sit idle (or park a "
+        "partial slow-loris request) before the event loop reaps it",
+    )
+    v.add_argument(
+        "--max-connections", type=int, default=8192,
+        help="concurrent-connection cap per worker (fd protection; "
+        "admission control proper is --max-queue)",
     )
     v.add_argument(
         "--slo-latency-ms", type=float, default=250.0,

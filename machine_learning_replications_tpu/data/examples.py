@@ -40,9 +40,12 @@ assert tuple(EXAMPLE_PATIENT) == _SELECTED_17, "example patient order drifted fr
 
 def patient_row(params: dict[str, float] | None = None) -> np.ndarray:
     """Flatten a patient dict to the ``(1, 17)`` model input row, exactly as
-    ``predict_hf.py:29-31`` does."""
+    ``predict_hf.py:29-31`` does. One allocation — this runs per request
+    on the serving hot path."""
     d = EXAMPLE_PATIENT if params is None else params
-    return np.reshape([d[k] for k in EXAMPLE_PATIENT], (1, -1)).astype(np.float64)
+    return np.array(
+        [d[k] for k in EXAMPLE_PATIENT], dtype=np.float64
+    ).reshape(1, -1)
 
 
 def validate_patient(patient: dict) -> np.ndarray:

@@ -99,6 +99,26 @@ class Histogram:
             self._ring[self._ring_n % self._ring.shape[0]] = v
             self._ring_n += 1
 
+    def observe_many(self, values) -> None:
+        """Record a batch of observations under ONE lock acquisition —
+        the serving flush path records a whole micro-batch's latencies
+        and queue waits at once, and per-row lock round-trips are
+        measurable at a thousand requests per second."""
+        vs = [float(v) for v in values]
+        if not vs:
+            return
+        with self._lock:
+            size = self._ring.shape[0]
+            for v in vs:
+                i = 0
+                while i < len(self._bounds) and v > self._bounds[i]:
+                    i += 1
+                self._counts[i] += 1
+                self._sum += v
+                self._ring[self._ring_n % size] = v
+                self._ring_n += 1
+            self._count += len(vs)
+
     def quantile(self, q: float | Sequence[float]):
         """Quantile(s) in [0, 1] over the recent-observation ring
         (NaN when empty)."""

@@ -105,7 +105,8 @@ def default_slos(
 
 
 class _PerSLO:
-    __slots__ = ("slo", "total", "bad", "ring", "ring_bad", "ring_n")
+    __slots__ = ("slo", "total", "bad", "ring", "ring_bad", "ring_n",
+                 "c_requests", "c_bad", "g_good", "g_burn", "g_remaining")
 
     def __init__(self, slo: SLO, window: int) -> None:
         self.slo = slo
@@ -114,6 +115,11 @@ class _PerSLO:
         self.ring = bytearray(window)  # 1 = bad event, ring of recents
         self.ring_bad = 0
         self.ring_n = 0
+        # Child instruments cached at declaration (observe() runs per
+        # request on the serving flush path; resolving five label sets
+        # per call is measurable at event-loop throughput).
+        self.c_requests = self.c_bad = None
+        self.g_good = self.g_burn = self.g_remaining = None
 
 
 class SLOTracker:
@@ -161,14 +167,20 @@ class SLOTracker:
             "slo_target_ratio", "The declared SLO target (constant).",
             labels=("slo",),
         )
-        for s in slos:
+        for st in self._state:
+            s = st.slo
             # Materialize every series at declaration: a scrape taken
-            # before the first request still shows the objectives.
-            self._requests.labels(slo=s.name)
-            self._bad.labels(slo=s.name)
-            self._good_ratio.set(1.0, slo=s.name)
-            self._burn.set(0.0, slo=s.name)
-            self._remaining.set(1.0, slo=s.name)
+            # before the first request still shows the objectives. The
+            # children are kept — observe() updates them without a label
+            # resolution per call.
+            st.c_requests = self._requests.labels(slo=s.name)
+            st.c_bad = self._bad.labels(slo=s.name)
+            st.g_good = self._good_ratio.labels(slo=s.name)
+            st.g_burn = self._burn.labels(slo=s.name)
+            st.g_remaining = self._remaining.labels(slo=s.name)
+            st.g_good.set(1.0)
+            st.g_burn.set(0.0)
+            st.g_remaining.set(1.0)
             self._target.set(s.target, slo=s.name)
 
     @property
@@ -191,16 +203,13 @@ class SLOTracker:
                 n_window = min(st.ring_n, len(st.ring))
                 bad_ratio = st.ring_bad / n_window
                 lifetime_bad_ratio = st.bad / st.total
-            name = st.slo.name
             budget = st.slo.budget
-            self._requests.inc(slo=name)
+            st.c_requests.inc()
             if not good:
-                self._bad.inc(slo=name)
-            self._good_ratio.set(1.0 - bad_ratio, slo=name)
-            self._burn.set(bad_ratio / budget, slo=name)
-            self._remaining.set(
-                1.0 - lifetime_bad_ratio / budget, slo=name
-            )
+                st.c_bad.inc()
+            st.g_good.set(1.0 - bad_ratio)
+            st.g_burn.set(bad_ratio / budget)
+            st.g_remaining.set(1.0 - lifetime_bad_ratio / budget)
 
     def snapshot(self) -> list[dict]:
         out = []

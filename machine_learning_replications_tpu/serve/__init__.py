@@ -4,15 +4,22 @@ The reference's only inference surface is a synchronous single-patient
 script (``predict_hf.py``); the ROADMAP's "serving heavy traffic" half had
 no subsystem behind it. This package is that subsystem, stdlib-only:
 
-  ``engine``   warm compiled batched predict over a fixed bucket ladder
-               (bounded jit cache, startup warmup, Orbax + pickle params)
-  ``batcher``  thread-safe micro-batching (max-batch / max-wait flush),
-               bounded admission with explicit load shedding, graceful
-               drain
-  ``server``   HTTP front end: ``/predict`` (17-variable patient JSON),
-               ``/healthz`` (liveness) + ``/readyz`` (readiness),
-               ``/metrics``, and the guarded ``/debug/*`` surfaces
-               (requests, profile, quality, faults)
+  ``engine``    warm compiled batched predict over a fixed bucket ladder
+                (bounded jit cache, startup warmup, Orbax + pickle params)
+  ``batcher``   thread-safe micro-batching (max-batch / max-wait flush),
+                bounded admission with explicit load shedding, graceful
+                drain
+  ``protocol``  pure HTTP/1.1 parse/respond rules — incremental parser
+                (pipelining, split reads), framing guards (400/413/431 +
+                desync closes), response builder; no sockets
+  ``transport`` the non-blocking ``selectors`` event loop: one thread per
+                worker owns every socket, keep-alive pipelining, explicit
+                backpressure, idle/slow-loris reaping, ``SO_REUSEPORT``
+                pre-fork sharding (``cli serve --workers N``)
+  ``server``    the application: ``/predict`` (17-variable patient JSON),
+                ``/healthz`` (liveness) + ``/readyz`` (readiness),
+                ``/metrics``, and the guarded ``/debug/*`` surfaces
+                (requests, profile, quality, faults)
 
 The engine runs supervised by default (``resilience.supervisor``):
 watchdog deadline per flush, circuit breaker, degraded-mode 503 +

@@ -1,13 +1,32 @@
-"""Stdlib HTTP front end for the serving layer.
+"""HTTP front end for the serving layer — application logic over the
+event-loop transport.
 
-Endpoints (``ThreadingHTTPServer`` — one thread per connection feeding the
-shared micro-batcher, no third-party dependencies):
+The stack is three layers since the transport refactor (docs/SERVING.md
+"Transport architecture"):
+
+  ``serve.protocol``   pure HTTP parse/respond rules (Content-Length
+                       framing guards, keep-alive/pipelining, desync
+                       closes) — no sockets, unit-testable.
+  ``serve.transport``  the non-blocking ``selectors`` event loop: one
+                       thread owns every socket, keep-alive pipelining,
+                       bounded read buffers, idle/slow-loris reaping,
+                       explicit backpressure (a socket with a request in
+                       flight is not read), ``SO_REUSEPORT`` pre-fork
+                       sharding for ``cli serve --workers N``.
+  this module          the endpoints below, plus request tracing, SLO
+                       accounting, quality monitoring, and degraded-mode
+                       shedding — unchanged semantics behind the new
+                       transport; the batcher/engine/supervisor stack
+                       behind it is untouched.
+
+Endpoints:
 
   POST /predict   body = the 17-variable patient JSON (``predict_hf.py:5-27``,
                   same validation as ``cli.py predict --patient``) → 200
                   ``{"probability": p, "text": "Probability of progressive
                   HF is: XX.XX %"}``. 400 on contract violations, 413 on
-                  oversized bodies (never read into memory), 503
+                  oversized bodies (never read into memory), 431 on
+                  oversized headers, 503
                   ``{"error": "overloaded"}`` when admission control sheds,
                   504 when an admitted request misses the request deadline
                   (it is cancelled, so the engine never computes it).
@@ -21,14 +40,15 @@ shared micro-batcher, no third-party dependencies):
   GET  /healthz   LIVENESS (always 200 while the process can answer) plus
                   the load signal an external prober wants: params family,
                   bucket ladder, warm flag, queue depth, uptime, the run
-                  id from the journal manifest when one is active, a
-                  compact model-quality block (``{"status":
-                  ok|warn|alert|disabled, "worst_feature", "worst_psi"}``),
-                  and — when the engine is supervised — the circuit
-                  breaker's state (``status`` reads ``degraded`` while the
-                  breaker is open). Liveness and readiness are split
-                  deliberately: a recovering replica must be rotated OUT
-                  (readiness false) without being KILLED (liveness true).
+                  id from the journal manifest when one is active, the
+                  worker id in multi-worker mode, a compact model-quality
+                  block (``{"status": ok|warn|alert|disabled,
+                  "worst_feature", "worst_psi"}``), and — when the engine
+                  is supervised — the circuit breaker's state (``status``
+                  reads ``degraded`` while the breaker is open). Liveness
+                  and readiness are split deliberately: a recovering
+                  replica must be rotated OUT (readiness false) without
+                  being KILLED (liveness true).
   GET  /readyz    READINESS: 200 only when the engine is warm, the server
                   is not draining, and the breaker is closed; 503 with the
                   blocking reasons otherwise — the signal a load balancer
@@ -39,9 +59,9 @@ shared micro-batcher, no third-party dependencies):
                   (jax compile counts/seconds and transfer bytes from
                   ``obs.jaxmon``, installed at ``make_server``; SLO burn
                   gauges from ``obs.slo``; flight-recorder sampling
-                  counters), so one scrape answers "is the server
-                  shedding?", "did it start recompiling?", and "how fast
-                  is the error budget burning?".
+                  counters; ``serve_worker_info{worker=…}`` in
+                  multi-worker mode so scrapes through the shared
+                  ``SO_REUSEPORT`` port stay attributable).
   GET  /debug/requests
                   the flight recorder's tail-sampled request traces
                   (every failure + the p99-slowest completions), newest
@@ -52,6 +72,8 @@ shared micro-batcher, no third-party dependencies):
                   (default 1) while traffic keeps flowing; replies with
                   the artifact file list. Single-flight: a capture in
                   progress makes concurrent calls fail fast with 409.
+                  (Runs on its own short-lived thread — a blocking capture
+                  must not stall the event loop.)
   GET  /debug/quality
                   the model-quality monitor's full snapshot
                   (``obs.quality``): drift status vs the training
@@ -78,7 +100,7 @@ rebuilds and re-warms the engine off the request path.
 
 ``ServerHandle.shutdown`` is the graceful path: mark draining (readiness
 drops), stop accepting, drain the batcher (admitted requests are never
-dropped), then stop the listener.
+dropped), flush every queued reply, then stop the listener.
 """
 
 from __future__ import annotations
@@ -86,21 +108,10 @@ from __future__ import annotations
 import json
 import math
 import os
+import sys
 import tempfile
 import threading
 import time
-from concurrent.futures import TimeoutError as FuturesTimeout
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlparse, parse_qs
-
-
-class _Server(ThreadingHTTPServer):
-    # Kernel accept backlog. The socketserver default (5) drops SYNs under
-    # open-loop bursts, so clients stall in 1 s / 3 s / 7 s TCP retransmit
-    # and overload shows up as silent kernel drops — it must instead reach
-    # the bounded batcher queue, whose explicit 503 is the shedding
-    # contract this layer is built around.
-    request_queue_size = 128
 
 from machine_learning_replications_tpu.obs import (
     jaxmon,
@@ -127,6 +138,9 @@ from machine_learning_replications_tpu.serve.engine import (
     BucketedPredictEngine,
 )
 from machine_learning_replications_tpu.serve.metrics import ServingMetrics
+from machine_learning_replications_tpu.serve.transport import (
+    EventLoopHttpServer,
+)
 
 # predict_hf.py:38-40 — the single-patient CLI prints exactly this line;
 # the HTTP reply carries it verbatim so the serving layer inherits the
@@ -143,21 +157,22 @@ def _retry_after(seconds: float) -> dict[str, str]:
 
 class ServerHandle:
     """A running serving stack: engine + batcher + metrics + request-trace
-    recorder + SLO tracker + HTTP listener."""
+    recorder + SLO tracker + event-loop HTTP listener."""
 
     def __init__(
         self, engine, batcher, metrics, httpd,
         recorder=None, slo_tracker=None, profile_dir: str | None = None,
-        quality=None,
+        quality=None, worker_id: int | None = None,
     ) -> None:
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
-        self.httpd = httpd
+        self.httpd = httpd  # transport.EventLoopHttpServer
         self.recorder = recorder
         self.slo_tracker = slo_tracker
         self.profile_dir = profile_dir
         self.quality = quality  # obs.quality.QualityMonitor or None
+        self.worker_id = worker_id  # pre-fork multi-worker id, or None
         # Graceful-drain marker: set FIRST in shutdown so /readyz drops
         # before admission closes — a load balancer stops routing here
         # while in-flight requests finish.
@@ -180,8 +195,9 @@ class ServerHandle:
 
     def shutdown(self, drain: bool = True) -> None:
         """Graceful stop: mark draining (readiness goes false), close
-        admission (draining by default), then stop the HTTP loop. Safe to
-        call more than once."""
+        admission (draining by default — every in-flight reply is still
+        written through the live event loop), then stop and flush the
+        transport. Safe to call more than once."""
         self.draining = True
         self.batcher.close(drain=drain)
         self.httpd.shutdown()
@@ -194,425 +210,437 @@ class ServerHandle:
             close_engine()
 
 
-def _make_handler(handle: ServerHandle, request_timeout_s: float, quiet: bool):
-    batcher, metrics, engine = handle.batcher, handle.metrics, handle.engine
-    recorder, slo_tracker = handle.recorder, handle.slo_tracker
+class _InFlight:
+    """One admitted /predict request: the race between the batcher's
+    completion (any flush thread) and the deadline timer (loop thread) is
+    resolved under a lock — exactly one of them replies."""
 
-    class Handler(BaseHTTPRequestHandler):
-        # Persistent connections keep the loadgen's closed loop honest
-        # (no per-request TCP handshake in the measured latency).
-        protocol_version = "HTTP/1.1"
-        # Socket-level read timeout (StreamRequestHandler applies this per
-        # connection): without it, every idle keep-alive client pins a
-        # handler thread forever in readline(). BaseServer.timeout would
-        # NOT do this — serve_forever ignores it. Also bounds how long a
-        # lingering idle connection can delay the drain-join in shutdown.
-        timeout = 5.0
-        # A patient JSON is ~600 bytes; anything near this bound is not a
-        # legitimate request, and an uncapped read would let one oversized
-        # POST buffer past every bound the admission queue enforces.
-        max_body_bytes = 64 * 1024
+    __slots__ = ("app", "trace", "responder", "future", "timer", "_done",
+                 "_lock")
 
-        def _reply(
-            self, code: int, body: bytes, ctype: str,
-            request_id: str | None = None,
-            headers: dict[str, str] | None = None,
-        ) -> None:
-            self.send_response(code)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            if request_id is not None:
-                # Echoed (or assigned) correlation id: the client can join
-                # its own latency record against /debug/requests samples.
-                self.send_header("X-Request-Id", request_id)
-            if headers:
-                for k, v in headers.items():
-                    self.send_header(k, v)
-            self.end_headers()
-            self.wfile.write(body)
+    def __init__(self, app, trace, responder, future) -> None:
+        self.app = app
+        self.trace = trace
+        self.responder = responder
+        self.future = future
+        self.timer = None
+        self._done = False
+        self._lock = threading.Lock()
 
-        def _json(
-            self, code: int, obj, request_id: str | None = None,
-            headers: dict[str, str] | None = None,
-        ) -> None:
-            self._reply(
-                code, json.dumps(obj).encode(), "application/json",
-                request_id=request_id, headers=headers,
+    def _claim(self) -> bool:
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+            return True
+
+    def on_deadline(self) -> None:
+        """The request missed its reply deadline (loop thread)."""
+        if not self._claim():
+            return
+        app, trace = self.app, self.trace
+        # Cancel so a still-queued request is dropped at flush time (the
+        # batcher skips cancelled entries) — otherwise every deadline miss
+        # still burns an engine slot computing an answer nobody reads,
+        # compounding the overload.
+        cancelled = self.future.cancel()
+        app.metrics.timeouts_total.inc()
+        msg = f"timed out after {app.request_timeout_s:g}s"
+        if cancelled:
+            # Truly unclaimed: the wait WAS the request — attribute it as
+            # queue time. When cancel LOSES the claim race the flush
+            # thread is stamping its own phases concurrently, so leave the
+            # trace to it.
+            trace.add_phase(
+                "queue_wait",
+                trace.phase_end("parse", trace.t_start),
+                time.perf_counter(),
             )
+        # Freeze BEFORE replying: a finished trace rejects late
+        # flush-thread stamps, so the published phases can never overlap
+        # each other or extend past t_end.
+        trace.finish("timeout", error=msg)
+        app._fail(self.responder, trace, "timeout", 504, msg)
 
-        def _readiness_blockers(self) -> list[str]:
-            """Why this replica should NOT receive traffic right now
-            (empty = ready). The three non-ready states are exactly the
-            ones a load balancer must react to without killing the
-            process: still compiling, draining out, or degraded."""
-            reasons = []
-            if not engine.warm:
-                reasons.append("warmup incomplete")
-            if handle.draining:
-                reasons.append("draining")
-            if getattr(engine, "breaker_open", False):
-                reasons.append("degraded: circuit breaker open")
-            return reasons
-
-        def do_GET(self) -> None:  # noqa: N802 (http.server API)
-            url = urlparse(self.path)
-            if url.path == "/healthz":
-                jrn = journal.get_journal()
-                breaker = (
-                    engine.snapshot()
-                    if isinstance(engine, SupervisedEngine) else None
-                )
-                degraded = getattr(engine, "breaker_open", False)
-                blockers = self._readiness_blockers()
-                self._json(200, {
-                    # Liveness stays 200 even degraded: the process is
-                    # alive and must NOT be restarted by a prober — the
-                    # supervisor is already rebuilding the engine, and a
-                    # kill would just add a cold start on top.
-                    "status": "degraded" if degraded else "ok",
-                    "ready": not blockers,
-                    "draining": handle.draining,
-                    "breaker": breaker,
-                    "params": type(engine.params).__name__,
-                    "buckets": list(engine.buckets),
-                    "warm": engine.warm,
-                    "queue_depth": batcher.queue_depth,
-                    "uptime_seconds": round(
-                        time.time() - metrics.started_at, 3
-                    ),
-                    "run_id": (
-                        jrn.manifest.get("run_id") if jrn is not None
-                        else None
-                    ),
-                    # Compact drift signal so an orchestrator can act on
-                    # model-quality degradation from the same probe it
-                    # already polls (full detail: /debug/quality).
-                    "quality": (
-                        handle.quality.health()
-                        if handle.quality is not None
-                        else {"status": "disabled"}
-                    ),
-                })
-            elif url.path == "/readyz":
-                blockers = self._readiness_blockers()
-                self._json(
-                    200 if not blockers else 503,
-                    {"ready": not blockers, "reasons": blockers},
-                )
-            elif url.path == "/debug/faults":
-                if not faults.endpoint_enabled():
-                    self._json(403, {
-                        "error": "fault-injection endpoint disabled "
-                        "(start serve with --inject or --fault-endpoint)",
-                    })
-                else:
-                    self._json(200, faults.snapshot())
-            elif url.path == "/debug/quality":
-                if handle.quality is None:
-                    self._json(200, qualitymod.disabled_snapshot(
-                        "no reference profile in the served params "
-                        "(or started with --no-quality)"
-                    ))
-                else:
-                    self._json(200, handle.quality.snapshot(detail=True))
-            elif url.path == "/debug/requests":
-                try:
-                    n = int(parse_qs(url.query).get("n", ["64"])[0])
-                except ValueError:
-                    self._json(400, {"error": "n must be an integer"})
-                    return
-                self._json(200, {
-                    "stats": recorder.stats(),
-                    "slo": (
-                        slo_tracker.snapshot()
-                        if slo_tracker is not None else []
-                    ),
-                    "requests": recorder.snapshot(n),
-                })
-            elif url.path == "/debug/profile":
-                try:
-                    seconds = float(
-                        parse_qs(url.query).get("seconds", ["1"])[0]
-                    )
-                except ValueError:
-                    self._json(400, {"error": "seconds must be a number"})
-                    return
-                try:
-                    artifact = profiler.capture(seconds, handle.profile_dir)
-                except profiler.ProfilerBusy as exc:
-                    self._json(409, {"error": str(exc)})
-                    return
-                except ValueError as exc:
-                    self._json(400, {"error": str(exc)})
-                    return
-                except Exception as exc:  # profiler backend failure
-                    self._json(500, {
-                        "error": f"{type(exc).__name__}: {exc}",
-                    })
-                    return
-                self._json(200, artifact)
-            elif url.path == "/metrics":
-                fmt = parse_qs(url.query).get("format", ["prometheus"])[0]
-                if fmt == "json":
-                    snap = metrics.snapshot()
-                    snap["runtime"] = REGISTRY.snapshot()
-                    self._json(200, snap)
-                else:
-                    # serve_* exposition first, byte-identical to the
-                    # standalone render; the global registry (jax compile
-                    # and transfer accounting) appended as its own
-                    # families.
-                    text = metrics.render_prometheus() + \
-                        REGISTRY.render_prometheus()
-                    self._reply(
-                        200, text.encode(), "text/plain; version=0.0.4",
-                    )
-            else:
-                self._json(404, {"error": f"no such path: {url.path}"})
-
-        def _fail(
-            self, trace, status: str, code: int, message: str,
-            observe_slo: bool = True,
-            headers: dict[str, str] | None = None,
-        ) -> None:
-            """Terminal error path for a traced /predict request: reply
-            (respond phase stamped around the write), finish + record the
-            trace, and feed the SLO tracker (client-fault 4xx paths pass
-            ``observe_slo=False`` — a malformed body is not a served
-            request the availability objective can lose). Recording runs
-            in a finally: a client that already hung up makes the write
-            raise, and a disconnect mid-incident must not exempt the
-            request from the burn gauges or the flight recorder."""
-            t0 = time.perf_counter()
-            try:
-                self._json(
-                    code, {"error": message}, request_id=trace.request_id,
-                    headers=headers,
-                )
-            finally:
-                trace.add_phase("respond", t0, time.perf_counter())
-                trace.finish(status, error=message)
-                if slo_tracker is not None and observe_slo:
-                    slo_tracker.observe(trace.total_s, ok=False)
-                recorder.record(trace)
-
-        def _post_faults(self) -> None:
-            """POST /debug/faults: arm/disarm/reset the injection registry
-            over HTTP (the chaos driver's control plane). Guarded — see
-            ``faults.enable_endpoint``."""
-            if not faults.endpoint_enabled():
-                self.close_connection = True
-                self._json(403, {
-                    "error": "fault-injection endpoint disabled "
-                    "(start serve with --inject or --fault-endpoint)",
-                })
-                return
-            try:
-                length = int(self.headers.get("Content-Length", ""))
-            except ValueError:
-                length = -1
-            if length < 0 or length > self.max_body_bytes:
-                self.close_connection = True
-                self._json(400, {"error": "missing or oversized body"})
-                return
-            try:
-                req = json.loads(self.rfile.read(length) or b"{}")
-                if not isinstance(req, dict):
-                    raise ValueError("body must be a JSON object")
-                if "arm" in req:
-                    faults.arm(str(req["arm"]))
-                elif "disarm" in req:
-                    faults.disarm(str(req["disarm"]))
-                elif req.get("reset"):
-                    faults.reset()
-                else:
-                    raise ValueError(
-                        'expected {"arm": SPEC}, {"disarm": SITE}, '
-                        'or {"reset": true}'
-                    )
-            except (ValueError, json.JSONDecodeError) as exc:
-                self._json(400, {"error": str(exc)})
-                return
-            self._json(200, faults.snapshot())
-
-        def do_POST(self) -> None:  # noqa: N802 (http.server API)
-            path = urlparse(self.path).path
-            if path == "/debug/faults":
-                self._post_faults()
-                return
-            if path != "/predict":
-                # Unread body on a keep-alive connection would be parsed
-                # as the NEXT request line — close instead of desyncing.
-                self.close_connection = True
-                self._json(404, {"error": f"no such path: {self.path}"})
-                return
-            from machine_learning_replications_tpu.data.examples import (
-                validate_patient,
-            )
-
-            # Request identity at admission: honor an inbound
-            # X-Request-Id (sanitized — a hostile header must not inject
-            # into logs/replies), mint one otherwise; every reply below
-            # echoes it.
-            trace = reqtrace.RequestTrace(
-                reqtrace.sanitize_request_id(
-                    self.headers.get("X-Request-Id")
-                )
-            )
-            try:
-                # Faultpoint at admission, before the body is touched: an
-                # injected parse fault replies an explicit 500 (body
-                # unread, so the connection closes instead of desyncing).
-                faults.fire("server.parse")
-            except faults.InjectedFault as exc:
-                self.close_connection = True
-                self._fail(trace, "error", 500, str(exc))
-                return
-            try:
-                length = int(self.headers.get("Content-Length", ""))
-            except ValueError:
-                length = -1
-            if length < 0:
-                # Missing, unparseable, or negative Content-Length: the
-                # body length is unknowable (rfile.read(negative) would
-                # even read to EOF, stalling until the socket timeout),
-                # so the connection cannot be resynced either — close it.
-                self.close_connection = True
-                self._fail(
-                    trace, "bad_request", 400,
-                    "missing or invalid Content-Length", observe_slo=False,
-                )
-                return
-            try:
-                if length > self.max_body_bytes:
-                    # Don't read a body we've rejected: close the
-                    # connection instead of draining it.
-                    self.close_connection = True
-                    self._fail(
-                        trace, "bad_request", 413,
-                        f"body exceeds {self.max_body_bytes} bytes",
-                        observe_slo=False,
-                    )
-                    return
-                patient = json.loads(self.rfile.read(length) or b"{}")
-                row = validate_patient(patient)
-            except (ValueError, json.JSONDecodeError) as exc:
-                self._fail(
-                    trace, "bad_request", 400, str(exc), observe_slo=False
-                )
-                return
-            trace.add_phase("parse", trace.t_start, time.perf_counter())
-            # Degraded mode: while the breaker is open the engine cannot
-            # answer, so shed HERE — an explicit 503 with a Retry-After
-            # derived from the restart schedule — instead of admitting
-            # into a queue that can only fail or time the client out.
-            if getattr(engine, "breaker_open", False):
-                # Both shed families move, once each: serve_shed_total is
-                # THE shed-rate metric (overload + degraded alike — same
-                # explicit-503 contract), resilience_degraded_sheds_total
-                # attributes the degraded subset.
-                metrics.shed_total.inc()
-                DEGRADED_SHEDS.inc()
-                trace.note(shed=True, degraded=True)
-                self._fail(
-                    trace, "shed", 503, "degraded: engine restarting",
-                    headers=_retry_after(engine.retry_after_s()),
-                )
-                return
-            try:
-                future = batcher.submit(row[0], trace=trace)
-            except Overloaded:
-                trace.note(shed=True)
-                self._fail(trace, "shed", 503, "overloaded")
-                return
-            except RuntimeError as exc:  # closed during shutdown
-                self._fail(trace, "shed", 503, str(exc))
-                return
-            try:
-                prob = future.result(timeout=request_timeout_s)
-            except FuturesTimeout:
-                # Cancel so a still-queued request is dropped at flush time
-                # (batcher skips cancelled entries) — otherwise every
-                # deadline miss still burns an engine slot computing an
-                # answer nobody reads, compounding the overload.
-                cancelled = future.cancel()
-                metrics.timeouts_total.inc()
-                msg = f"timed out after {request_timeout_s:g}s"
-                if cancelled:
-                    # Truly unclaimed: the wait WAS the request —
-                    # attribute it as queue time. When cancel LOSES the
-                    # claim race the flush thread is stamping its own
-                    # phases concurrently, so leave the trace to it.
-                    trace.add_phase(
-                        "queue_wait",
-                        trace.phase_end("parse", trace.t_start),
-                        time.perf_counter(),
-                    )
-                # Freeze BEFORE replying: a finished trace rejects late
-                # flush-thread stamps, so the published phases can never
-                # overlap each other or extend past t_end (_fail's
-                # respond/finish calls below become harmless no-ops).
-                trace.finish("timeout", error=msg)
-                self._fail(trace, "timeout", 504, msg)
-                return
-            except BreakerOpen as exc:
+    def on_done(self, future) -> None:
+        """The batcher resolved the future (flush thread — or inline when
+        already resolved at callback registration)."""
+        if not self._claim():
+            return  # the deadline path already answered (and cancelled us)
+        if self.timer is not None:
+            self.timer.cancel()
+        app, trace, responder = self.app, self.trace, self.responder
+        exc = future.exception()
+        if exc is not None:
+            if isinstance(exc, BreakerOpen):
                 # The breaker opened after this request was admitted (its
                 # flush ran while degraded): same explicit shed contract
                 # as the pre-admission check.
                 DEGRADED_SHEDS.inc()
                 trace.note(shed=True, degraded=True)
-                self._fail(
-                    trace, "shed", 503, str(exc),
+                app._fail(
+                    responder, trace, "shed", 503, str(exc),
                     headers=_retry_after(exc.retry_after_s),
                 )
-                return
-            except ComputeDeadlineExceeded as exc:
+            elif isinstance(exc, ComputeDeadlineExceeded):
                 # The watchdog abandoned a wedged compute: the request is
                 # dead in bounded time — 504, never a hang.
-                self._fail(trace, "timeout", 504, str(exc))
-                return
-            except Exception as exc:
-                self._fail(trace, "error", 500, str(exc))
-                return
-            # Respond phase starts at device-compute end, so the phases
-            # partition the whole server-side interval: future-wakeup
-            # scheduling delay is response-path latency, not dead time.
-            # Recording in a finally: a hung-up client makes the write
-            # raise, and the request must still reach the SLO tracker
-            # and the flight recorder (the engine did serve it).
-            t_resp0 = trace.phase_end("device_compute", time.perf_counter())
-            try:
-                # Faultpoint on the respond path: an injected fault here
-                # drops the connection with NOTHING written — the client
-                # sees an explicit transport error. A partial/garbled 200
-                # body would be the one unforgivable failure mode (a
-                # wrong answer); a dead socket is not.
-                faults.fire("server.respond")
-            except faults.InjectedFault as exc:
-                self.close_connection = True
-                trace.add_phase("respond", t_resp0, time.perf_counter())
-                trace.finish("error", error=str(exc))
-                if slo_tracker is not None:
-                    slo_tracker.observe(trace.total_s, ok=False)
-                recorder.record(trace)
-                return
-            try:
-                self._json(200, {
-                    "probability": prob,
-                    "text": OUTPUT_CONTRACT.format(100.0 * prob),
-                }, request_id=trace.request_id)
-            finally:
-                trace.add_phase("respond", t_resp0, time.perf_counter())
-                trace.finish("ok")
-                if slo_tracker is not None:
-                    slo_tracker.observe(trace.total_s, ok=True)
-                recorder.record(trace)
+                app._fail(responder, trace, "timeout", 504, str(exc))
+            else:
+                app._fail(responder, trace, "error", 500, str(exc))
+            return
+        prob = future.result()
+        # Respond phase starts at device-compute end, so the phases
+        # partition the whole server-side interval: completion-callback
+        # scheduling delay is response-path latency, not dead time.
+        t_resp0 = trace.phase_end("device_compute", time.perf_counter())
+        try:
+            # Faultpoint on the respond path: an injected fault here drops
+            # the connection with NOTHING written — the client sees an
+            # explicit transport error. A partial/garbled 200 body would
+            # be the one unforgivable failure mode (a wrong answer); a
+            # dead socket is not.
+            faults.fire("server.respond")
+        except faults.InjectedFault as exc:
+            responder.abort()
+            trace.add_phase("respond", t_resp0, time.perf_counter())
+            trace.finish("error", error=str(exc))
+            if app.slo_tracker is not None:
+                app.slo_tracker.observe(trace.total_s, ok=False)
+            app.recorder.record(trace)
+            return
+        responder.send_json(200, {
+            "probability": prob,
+            "text": OUTPUT_CONTRACT.format(100.0 * prob),
+        }, request_id=trace.request_id)
+        trace.add_phase("respond", t_resp0, time.perf_counter())
+        trace.finish("ok")
+        if app.slo_tracker is not None:
+            app.slo_tracker.observe(trace.total_s, ok=True)
+        app.recorder.record(trace)
 
-        def log_message(self, fmt: str, *args) -> None:
-            if not quiet:
-                super().log_message(fmt, *args)
 
-    return Handler
+class _App:
+    """The application the transport dispatches into. Handlers run ON the
+    event-loop thread and never block: /predict completes through the
+    batcher future's done-callback, /debug/profile on its own thread —
+    everything else is fast enough to answer inline."""
+
+    def __init__(self, handle: ServerHandle, request_timeout_s: float,
+                 quiet: bool) -> None:
+        self.handle = handle
+        self.request_timeout_s = float(request_timeout_s)
+        self.quiet = quiet
+        # Captured once (same lifetime as the old closure-captured
+        # handler): tests may swap batcher internals, never these slots.
+        self.batcher = handle.batcher
+        self.metrics = handle.metrics
+        self.engine = handle.engine
+        self.recorder = handle.recorder
+        self.slo_tracker = handle.slo_tracker
+
+    # -- transport interface -----------------------------------------------
+
+    def handle_request(self, req, rsp) -> None:
+        if not self.quiet:
+            print(f"{req.method} {req.target}", file=sys.stderr)
+        if req.method == "GET":
+            self._get(req, rsp)
+        elif req.method == "POST":
+            self._post(req, rsp)
+        else:
+            rsp.send_json(
+                501, {"error": f"unsupported method {req.method}"},
+                close=True,
+            )
+
+    def handle_protocol_error(self, exc, rsp) -> None:
+        """An unframeable request (bad Content-Length, oversized body or
+        headers, malformed line). The reply always closes the connection
+        — the parser no longer knows where the next request starts. A
+        /predict failure still gets a trace (client-fault: it never
+        reaches the SLO — a malformed body is not a served request the
+        availability objective can lose)."""
+        if exc.path == "/predict":
+            trace = reqtrace.RequestTrace(
+                reqtrace.sanitize_request_id(exc.headers.get("x-request-id"))
+            )
+            self._fail(
+                rsp, trace, "bad_request", exc.code, exc.message,
+                observe_slo=False, close=True,
+            )
+        else:
+            rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+    # -- failure path ------------------------------------------------------
+
+    def _fail(
+        self, rsp, trace, status: str, code: int, message: str,
+        observe_slo: bool = True,
+        headers: dict[str, str] | None = None,
+        close: bool = False,
+    ) -> None:
+        """Terminal error path for a traced /predict request: reply
+        (respond phase stamped around the enqueue), finish + record the
+        trace, and feed the SLO tracker (client-fault 4xx paths pass
+        ``observe_slo=False``). The responder never raises — a client
+        that already hung up cannot exempt its request from the burn
+        gauges or the flight recorder (the transport accounts the write
+        failure separately)."""
+        t0 = time.perf_counter()
+        rsp.send_json(
+            code, {"error": message}, request_id=trace.request_id,
+            headers=headers, close=close,
+        )
+        trace.add_phase("respond", t0, time.perf_counter())
+        trace.finish(status, error=message)
+        if self.slo_tracker is not None and observe_slo:
+            self.slo_tracker.observe(trace.total_s, ok=False)
+        self.recorder.record(trace)
+
+    # -- GET ----------------------------------------------------------------
+
+    def _readiness_blockers(self) -> list[str]:
+        """Why this replica should NOT receive traffic right now (empty =
+        ready). The three non-ready states are exactly the ones a load
+        balancer must react to without killing the process: still
+        compiling, draining out, or degraded."""
+        reasons = []
+        if not self.engine.warm:
+            reasons.append("warmup incomplete")
+        if self.handle.draining:
+            reasons.append("draining")
+        if getattr(self.engine, "breaker_open", False):
+            reasons.append("degraded: circuit breaker open")
+        return reasons
+
+    def _get(self, req, rsp) -> None:
+        path, handle, engine = req.path, self.handle, self.engine
+        if path == "/healthz":
+            jrn = journal.get_journal()
+            breaker = (
+                engine.snapshot()
+                if isinstance(engine, SupervisedEngine) else None
+            )
+            degraded = getattr(engine, "breaker_open", False)
+            blockers = self._readiness_blockers()
+            rsp.send_json(200, {
+                # Liveness stays 200 even degraded: the process is alive
+                # and must NOT be restarted by a prober — the supervisor
+                # is already rebuilding the engine, and a kill would just
+                # add a cold start on top.
+                "status": "degraded" if degraded else "ok",
+                "ready": not blockers,
+                "draining": handle.draining,
+                "breaker": breaker,
+                "params": type(engine.params).__name__,
+                "buckets": list(engine.buckets),
+                "warm": engine.warm,
+                "queue_depth": self.batcher.queue_depth,
+                "uptime_seconds": round(
+                    time.time() - self.metrics.started_at, 3
+                ),
+                "run_id": (
+                    jrn.manifest.get("run_id") if jrn is not None else None
+                ),
+                "worker": handle.worker_id,
+                # Compact drift signal so an orchestrator can act on
+                # model-quality degradation from the same probe it
+                # already polls (full detail: /debug/quality).
+                "quality": (
+                    handle.quality.health()
+                    if handle.quality is not None
+                    else {"status": "disabled"}
+                ),
+            })
+        elif path == "/readyz":
+            blockers = self._readiness_blockers()
+            rsp.send_json(
+                200 if not blockers else 503,
+                {"ready": not blockers, "reasons": blockers},
+            )
+        elif path == "/debug/faults":
+            if not faults.endpoint_enabled():
+                rsp.send_json(403, {
+                    "error": "fault-injection endpoint disabled "
+                    "(start serve with --inject or --fault-endpoint)",
+                })
+            else:
+                rsp.send_json(200, faults.snapshot())
+        elif path == "/debug/quality":
+            if handle.quality is None:
+                rsp.send_json(200, qualitymod.disabled_snapshot(
+                    "no reference profile in the served params "
+                    "(or started with --no-quality)"
+                ))
+            else:
+                rsp.send_json(200, handle.quality.snapshot(detail=True))
+        elif path == "/debug/requests":
+            try:
+                n = int(req.query_param("n", "64"))
+            except ValueError:
+                rsp.send_json(400, {"error": "n must be an integer"})
+                return
+            rsp.send_json(200, {
+                "stats": self.recorder.stats(),
+                "slo": (
+                    self.slo_tracker.snapshot()
+                    if self.slo_tracker is not None else []
+                ),
+                "requests": self.recorder.snapshot(n),
+            })
+        elif path == "/debug/profile":
+            try:
+                seconds = float(req.query_param("seconds", "1"))
+            except ValueError:
+                rsp.send_json(400, {"error": "seconds must be a number"})
+                return
+            # The capture blocks for its whole window — on a dedicated
+            # short-lived thread, never the event loop (a 10 s capture
+            # inline would stall every connection for 10 s).
+            threading.Thread(
+                target=self._profile_capture, args=(seconds, rsp),
+                name="serve-profile", daemon=True,
+            ).start()
+        elif path == "/metrics":
+            fmt = req.query_param("format", "prometheus")
+            if fmt == "json":
+                snap = self.metrics.snapshot()
+                snap["runtime"] = REGISTRY.snapshot()
+                rsp.send_json(200, snap)
+            else:
+                # serve_* exposition first, byte-identical to the
+                # standalone render; the global registry (jax compile and
+                # transfer accounting) appended as its own families.
+                text = self.metrics.render_prometheus() + \
+                    REGISTRY.render_prometheus()
+                rsp.send(
+                    200, text.encode(), "text/plain; version=0.0.4",
+                )
+        else:
+            rsp.send_json(404, {"error": f"no such path: {path}"})
+
+    def _profile_capture(self, seconds: float, rsp) -> None:
+        try:
+            artifact = profiler.capture(seconds, self.handle.profile_dir)
+        except profiler.ProfilerBusy as exc:
+            rsp.send_json(409, {"error": str(exc)})
+            return
+        except ValueError as exc:
+            rsp.send_json(400, {"error": str(exc)})
+            return
+        except Exception as exc:  # profiler backend failure
+            rsp.send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        rsp.send_json(200, artifact)
+
+    # -- POST ---------------------------------------------------------------
+
+    def _post(self, req, rsp) -> None:
+        if req.path == "/debug/faults":
+            self._post_faults(req, rsp)
+            return
+        if req.path != "/predict":
+            # The body was framed and consumed, but a POST to an unknown
+            # path keeps the threaded server's contract: reply 404 and
+            # close.
+            rsp.send_json(
+                404, {"error": f"no such path: {req.target}"}, close=True,
+            )
+            return
+        self._predict(req, rsp)
+
+    def _post_faults(self, req, rsp) -> None:
+        """POST /debug/faults: arm/disarm/reset the injection registry
+        over HTTP (the chaos driver's control plane). Guarded — see
+        ``faults.enable_endpoint``."""
+        if not faults.endpoint_enabled():
+            rsp.send_json(403, {
+                "error": "fault-injection endpoint disabled "
+                "(start serve with --inject or --fault-endpoint)",
+            }, close=True)
+            return
+        try:
+            body = json.loads(req.body or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            if "arm" in body:
+                faults.arm(str(body["arm"]))
+            elif "disarm" in body:
+                faults.disarm(str(body["disarm"]))
+            elif body.get("reset"):
+                faults.reset()
+            else:
+                raise ValueError(
+                    'expected {"arm": SPEC}, {"disarm": SITE}, '
+                    'or {"reset": true}'
+                )
+        except (ValueError, json.JSONDecodeError) as exc:
+            rsp.send_json(400, {"error": str(exc)})
+            return
+        rsp.send_json(200, faults.snapshot())
+
+    def _predict(self, req, rsp) -> None:
+        from machine_learning_replications_tpu.data.examples import (
+            validate_patient,
+        )
+
+        # Request identity at admission: honor an inbound X-Request-Id
+        # (sanitized — a hostile header must not inject into logs/replies),
+        # mint one otherwise; every reply below echoes it.
+        trace = reqtrace.RequestTrace(
+            reqtrace.sanitize_request_id(req.get_header("x-request-id"))
+        )
+        try:
+            # Faultpoint at admission, before the body is parsed: an
+            # injected parse fault replies an explicit 500 and closes.
+            faults.fire("server.parse")
+        except faults.InjectedFault as exc:
+            self._fail(rsp, trace, "error", 500, str(exc), close=True)
+            return
+        try:
+            patient = json.loads(req.body or b"{}")
+            row = validate_patient(patient)
+        except (ValueError, json.JSONDecodeError) as exc:
+            self._fail(
+                rsp, trace, "bad_request", 400, str(exc), observe_slo=False
+            )
+            return
+        trace.add_phase("parse", trace.t_start, time.perf_counter())
+        # Degraded mode: while the breaker is open the engine cannot
+        # answer, so shed HERE — an explicit 503 with a Retry-After
+        # derived from the restart schedule — instead of admitting into a
+        # queue that can only fail or time the client out.
+        if getattr(self.engine, "breaker_open", False):
+            # Both shed families move, once each: serve_shed_total is THE
+            # shed-rate metric (overload + degraded alike — same
+            # explicit-503 contract), resilience_degraded_sheds_total
+            # attributes the degraded subset.
+            self.metrics.shed_total.inc()
+            DEGRADED_SHEDS.inc()
+            trace.note(shed=True, degraded=True)
+            self._fail(
+                rsp, trace, "shed", 503, "degraded: engine restarting",
+                headers=_retry_after(self.engine.retry_after_s()),
+            )
+            return
+        try:
+            future = self.batcher.submit(row[0], trace=trace)
+        except Overloaded:
+            trace.note(shed=True)
+            self._fail(rsp, trace, "shed", 503, "overloaded")
+            return
+        except RuntimeError as exc:  # closed during shutdown
+            self._fail(rsp, trace, "shed", 503, str(exc))
+            return
+        ctx = _InFlight(self, trace, rsp, future)
+        # Deadline on the loop clock; the done-callback and the timer race
+        # under the ctx lock, so exactly one replies. add_done_callback
+        # runs inline when the future already resolved.
+        ctx.timer = self.handle.httpd.call_later(
+            self.request_timeout_s, ctx.on_deadline
+        )
+        future.add_done_callback(ctx.on_done)
 
 
 def make_server(
@@ -645,6 +673,10 @@ def make_server(
     restart_backoff_s: float = 0.5,
     restart_backoff_max_s: float = 30.0,
     fault_endpoint: bool = False,
+    idle_timeout_s: float = 5.0,
+    max_connections: int = 8192,
+    reuse_port: bool = False,
+    worker_id: int | None = None,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
@@ -681,11 +713,24 @@ def make_server(
     ``fault_endpoint`` opts the process into ``/debug/faults`` chaos
     control (``resilience.faults``).
 
+    Transport (``serve.transport``): a non-blocking event loop serves
+    every connection from one thread — keep-alive pipelining, bounded
+    buffers, idle/slow-loris reaping after ``idle_timeout_s``, at most
+    ``max_connections`` concurrent sockets. ``reuse_port`` binds with
+    ``SO_REUSEPORT`` for the pre-fork multi-worker mode (``cli serve
+    --workers N``); ``worker_id`` threads the worker's identity into
+    ``/healthz``, ``/metrics`` (``serve_worker_info{worker=…}``), and —
+    via the CLI — the journal manifest, so scrapes and journals through
+    the shared port stay attributable to a specific worker process.
+
     The listener BINDS before warmup runs: a port conflict fails in
     milliseconds instead of after the multi-second compile bill. Warmup
     still completes before this returns (warm standby — the first served
     request never pays a compile); start serving first and call
-    ``engine.warmup`` yourself for observable warm=false readiness."""
+    ``engine.warmup`` yourself for observable warm=false readiness. On
+    ANY failure (warmup included) the bound port is released — and the
+    same guarantee holds per worker in multi-worker mode, where a failed
+    worker must not wedge the shared port's replacement bind."""
     # Compile/transfer accounting BEFORE the engine exists, so the param
     # upload and every warmup compile land in the /metrics counters.
     jaxmon.install()
@@ -787,18 +832,28 @@ def make_server(
         profile_dir = os.path.join(
             tempfile.gettempdir(), f"mlr_profiles_{os.getpid()}"
         )
+    if worker_id is not None:
+        # Attribution through the shared SO_REUSEPORT port: every scrape
+        # names the worker process it landed on.
+        REGISTRY.gauge(
+            "serve_worker_info",
+            "Serving worker identity (pre-fork multi-worker mode); "
+            "constant 1, the worker label carries the id.",
+            labels=("worker",),
+        ).set(1, worker=str(worker_id))
     handle = ServerHandle(
         engine, batcher, metrics, None,
         recorder=recorder, slo_tracker=slo_tracker, profile_dir=profile_dir,
-        quality=quality_monitor,
+        quality=quality_monitor, worker_id=worker_id,
     )
-    handler = _make_handler(handle, request_timeout_s, quiet)
+    app = _App(handle, request_timeout_s, quiet)
     try:
-        handle.httpd = _Server((host, port), handler)
-        # Joinable handler threads: shutdown() must be able to wait for
-        # in-flight replies to finish writing (ThreadingHTTPServer's
-        # daemon default is excluded from server_close's thread join).
-        handle.httpd.daemon_threads = False
+        handle.httpd = EventLoopHttpServer(
+            (host, port), app,
+            idle_timeout_s=idle_timeout_s,
+            max_connections=max_connections,
+            reuse_port=reuse_port,
+        )
         if warmup:
             engine.warmup(say=say)
     except BaseException:

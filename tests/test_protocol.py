@@ -1,0 +1,406 @@
+"""Protocol layer (serve/protocol.py) as pure functions — no sockets —
+plus transport-level (serve/transport.py) behavior over live loopback
+sockets with a stub application (no jax, no engine).
+
+These are the wire rules the serving contract depends on, previously
+reachable only through a live stdlib server: pipelined requests in one
+TCP segment, requests split across arbitrary read boundaries, the
+Content-Length framing guards and their connection-close semantics,
+header/body caps (431/413), and the event loop's idle / slow-loris
+reaping and listener lifecycle.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from machine_learning_replications_tpu.serve import protocol
+from machine_learning_replications_tpu.serve.protocol import (
+    HttpRequest,
+    ProtocolError,
+    RequestParser,
+    build_response,
+)
+from machine_learning_replications_tpu.serve.transport import (
+    EventLoopHttpServer,
+)
+
+
+def _req_bytes(
+    method="POST", target="/predict", body=b'{"x": 1}',
+    headers=None, version="HTTP/1.1",
+):
+    head = [f"{method} {target} {version}", "Host: t"]
+    if body is not None:
+        head.append(f"Content-Length: {len(body)}")
+    for k, v in (headers or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + (body or b"")
+
+
+# ---------------------------------------------------------------------------
+# parser: framing, pipelining, split reads
+# ---------------------------------------------------------------------------
+
+
+def test_parse_single_request_with_body():
+    p = RequestParser()
+    p.feed(_req_bytes(body=b'{"a": 2}'))
+    req = p.next_request()
+    assert req.method == "POST" and req.path == "/predict"
+    assert req.body == b'{"a": 2}'
+    assert req.keep_alive is True  # HTTP/1.1 default
+    assert req.get_header("host") == "t"
+    assert p.next_request() is None and not p.has_partial()
+
+
+def test_pipelined_requests_in_one_segment():
+    """Two complete requests arriving in ONE feed drain one per call, in
+    order — the keep-alive pipelining case the threaded server could only
+    exercise through live sockets."""
+    p = RequestParser()
+    p.feed(_req_bytes(body=b"one") + _req_bytes(body=b"two!"))
+    r1 = p.next_request()
+    r2 = p.next_request()
+    assert (r1.body, r2.body) == (b"one", b"two!")
+    assert p.next_request() is None
+
+
+def test_request_split_across_arbitrary_reads():
+    """Byte-at-a-time feeding must produce exactly the same request —
+    the parser owns reassembly, whatever fragmentation TCP produces."""
+    raw = _req_bytes(body=b'{"split": true}')
+    p = RequestParser()
+    got = []
+    for i in range(len(raw)):
+        p.feed(raw[i:i + 1])
+        req = p.next_request()
+        if req is not None:
+            got.append(req)
+    assert len(got) == 1
+    assert got[0].body == b'{"split": true}'
+    # split across the header/body boundary specifically
+    p = RequestParser()
+    head_end = raw.find(b"\r\n\r\n") + 4
+    p.feed(raw[:head_end + 3])
+    assert p.next_request() is None  # body incomplete
+    p.feed(raw[head_end + 3:])
+    assert p.next_request().body == b'{"split": true}'
+
+
+def test_query_string_parsing():
+    p = RequestParser()
+    p.feed(_req_bytes(method="GET", target="/metrics?format=json&n=5",
+                      body=None))
+    req = p.next_request()
+    assert req.path == "/metrics"
+    assert req.query_param("format", "prometheus") == "json"
+    assert req.query_param("missing", "d") == "d"
+
+
+# ---------------------------------------------------------------------------
+# framing guards: Content-Length, caps, desync closes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cl", [None, "nope", "-5"])
+def test_post_bad_content_length_is_400(cl):
+    p = RequestParser()
+    head = "POST /predict HTTP/1.1\r\nHost: t\r\n"
+    if cl is not None:
+        head += f"Content-Length: {cl}\r\n"
+    p.feed((head + "\r\n").encode())
+    with pytest.raises(ProtocolError) as ei:
+        p.next_request()
+    assert ei.value.code == 400
+    assert ei.value.message == "missing or invalid Content-Length"
+    assert ei.value.path == "/predict"  # the app can still trace it
+
+
+def test_oversized_body_rejected_from_header_alone():
+    p = RequestParser(max_body_bytes=1024)
+    p.feed(b"POST /predict HTTP/1.1\r\nContent-Length: 999999\r\n\r\n")
+    with pytest.raises(ProtocolError) as ei:
+        p.next_request()
+    assert ei.value.code == 413
+    assert "exceeds 1024 bytes" in ei.value.message
+    # the body was never required: rejection came from the header
+    assert p.buffered < 1024
+
+
+def test_oversized_headers_431():
+    p = RequestParser(max_header_bytes=256)
+    # terminated but oversized
+    p.feed(b"GET / HTTP/1.1\r\nX-Big: " + b"x" * 300 + b"\r\n\r\n")
+    with pytest.raises(ProtocolError) as ei:
+        p.next_request()
+    assert ei.value.code == 431
+    # never-terminating header stream trips the cap too (the slow-loris
+    # flood shape)
+    p2 = RequestParser(max_header_bytes=256)
+    p2.feed(b"GET / HTTP/1.1\r\nX-Drip: " + b"y" * 400)
+    with pytest.raises(ProtocolError) as ei:
+        p2.next_request()
+    assert ei.value.code == 431
+
+
+def test_transfer_encoding_rejected():
+    p = RequestParser()
+    p.feed(b"POST /predict HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n")
+    with pytest.raises(ProtocolError) as ei:
+        p.next_request()
+    assert ei.value.code == 400
+
+
+def test_get_with_declared_body_stays_in_sync():
+    """A GET carrying a Content-Length body must be framed (consumed), or
+    the body bytes would be parsed as the next request line."""
+    p = RequestParser()
+    p.feed(_req_bytes(method="GET", target="/healthz", body=b"stray")
+           + _req_bytes(method="GET", target="/readyz", body=None))
+    r1 = p.next_request()
+    r2 = p.next_request()
+    assert r1.path == "/healthz" and r1.body == b"stray"
+    assert r2.path == "/readyz"
+
+
+def test_malformed_request_line():
+    p = RequestParser()
+    p.feed(b"TOTAL GARBAGE\r\n\r\n")
+    with pytest.raises(ProtocolError) as ei:
+        p.next_request()
+    assert ei.value.code == 400
+
+
+def test_keep_alive_version_semantics():
+    for version, conn_header, expected in [
+        ("HTTP/1.1", None, True),
+        ("HTTP/1.1", "close", False),
+        ("HTTP/1.0", None, False),
+        ("HTTP/1.0", "keep-alive", True),
+    ]:
+        p = RequestParser()
+        headers = {"Connection": conn_header} if conn_header else {}
+        p.feed(_req_bytes(method="GET", target="/", body=None,
+                          headers=headers, version=version))
+        assert p.next_request().keep_alive is expected, (
+            version, conn_header)
+
+
+# ---------------------------------------------------------------------------
+# response building
+# ---------------------------------------------------------------------------
+
+
+def test_build_response_framing():
+    out = build_response(200, b'{"ok": 1}', "application/json",
+                         request_id="rid-1", keep_alive=True)
+    head, _, body = out.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200 OK\r\n")
+    assert b"Content-Length: 9" in head
+    assert b"X-Request-Id: rid-1" in head
+    assert b"Connection: close" not in head
+    assert body == b'{"ok": 1}'
+
+    out = build_response(503, b"{}", "application/json",
+                         headers={"Retry-After": "3"}, keep_alive=False)
+    assert b"Connection: close" in out
+    assert b"Retry-After: 3" in out
+    assert b"HTTP/1.1 503 Service Unavailable" in out
+
+
+# ---------------------------------------------------------------------------
+# transport over live sockets (stub app, no jax)
+# ---------------------------------------------------------------------------
+
+
+class _EchoApp:
+    """Echoes the request body; /slow responds from another thread after
+    a delay (the cross-thread completion path /predict uses)."""
+
+    def __init__(self, marker="A"):
+        self.marker = marker
+        self.protocol_errors = []
+
+    def handle_request(self, req, rsp):
+        if req.path == "/slow":
+            def later():
+                time.sleep(0.05)
+                rsp.send_json(200, {"worker": self.marker})
+            threading.Thread(target=later, daemon=True).start()
+            return
+        if req.path == "/abort":
+            rsp.abort()
+            return
+        rsp.send(200, req.body or self.marker.encode(), "text/plain")
+
+    def handle_protocol_error(self, exc, rsp):
+        self.protocol_errors.append(exc.code)
+        rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+
+def _start(app, port=0, **kw):
+    server = EventLoopHttpServer(("127.0.0.1", port), app, **kw)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t
+
+
+def _recv_one_response(sock):
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return buf
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":")[1])
+    while len(rest) < length:
+        rest += sock.recv(65536)
+    return head, rest[:length], rest[length:]
+
+
+def test_transport_pipelined_requests_served_in_order():
+    server, t = _start(_EchoApp())
+    try:
+        host, port = server.server_address[:2]
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(_req_bytes(body=b"first") + _req_bytes(body=b"second"))
+            h1, b1, extra = _recv_one_response(s)
+            assert b1 == b"first"
+            # second reply rides the same connection
+            if len(extra) == 0:
+                h2, b2, _ = _recv_one_response(s)
+            else:
+                s2 = extra
+                while b"\r\n\r\n" not in s2:
+                    s2 += s.recv(65536)
+                h2, _, b2 = s2.partition(b"\r\n\r\n")
+                while not b2.endswith(b"second"):
+                    b2 += s.recv(65536)
+            assert b2.endswith(b"second")
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_transport_cross_thread_completion_and_keepalive_reuse():
+    server, t = _start(_EchoApp(marker="X"))
+    try:
+        host, port = server.server_address[:2]
+        with socket.create_connection((host, port), timeout=5) as s:
+            for _ in range(3):  # same socket, three sequential requests
+                s.sendall(_req_bytes(method="GET", target="/slow",
+                                     body=None))
+                head, body, _ = _recv_one_response(s)
+                assert b"200" in head.split(b"\r\n", 1)[0]
+                assert json.loads(body) == {"worker": "X"}
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_transport_idle_reaper_closes_parked_connections():
+    """An idle keep-alive connection and a slow-loris partial request are
+    both reaped after idle_timeout_s — EOF on the client side — while a
+    fresh connection still gets served."""
+    server, t = _start(_EchoApp(), idle_timeout_s=0.3)
+    try:
+        host, port = server.server_address[:2]
+        idle = socket.create_connection((host, port), timeout=5)
+        loris = socket.create_connection((host, port), timeout=5)
+        loris.sendall(b"POST /predict HTTP/1.1\r\nContent-Le")  # partial
+        idle.settimeout(3.0)
+        loris.settimeout(3.0)
+        assert idle.recv(1) == b""     # reaped: EOF, no bytes written
+        assert loris.recv(1) == b""   # slow loris reaped the same way
+        idle.close()
+        loris.close()
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(_req_bytes(body=b"alive"))
+            _, body, _ = _recv_one_response(s)
+            assert body == b"alive"
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_transport_protocol_error_closes_connection():
+    app = _EchoApp()
+    server, t = _start(app)
+    try:
+        host, port = server.server_address[:2]
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n\r\n")  # no CL
+            s.settimeout(5.0)
+            buf = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break  # server closed — the desync rule
+                buf += chunk
+            assert b"400" in buf.split(b"\r\n", 1)[0]
+            assert b"missing or invalid Content-Length" in buf
+        assert app.protocol_errors == [400]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_transport_abort_drops_connection_without_bytes():
+    server, t = _start(_EchoApp())
+    try:
+        host, port = server.server_address[:2]
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(_req_bytes(method="GET", target="/abort", body=None))
+            s.settimeout(5.0)
+            assert s.recv(1) == b""  # EOF with NOTHING written
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_listener_released_without_loop_ever_running():
+    """The warmup-failure shape: the listener binds, the loop never runs,
+    server_close() must release the port for an immediate rebind."""
+    app = _EchoApp()
+    s1 = EventLoopHttpServer(("127.0.0.1", 0), app)
+    port = s1.server_address[1]
+    s1.server_close()
+    # rebind the SAME port immediately — EADDRINUSE here is the bug
+    s2 = EventLoopHttpServer(("127.0.0.1", port), app)
+    assert s2.server_address[1] == port
+    s2.server_close()
+
+
+def test_so_reuseport_two_loops_share_a_port():
+    """The pre-fork worker mechanism in one process: two event loops bind
+    the same port with SO_REUSEPORT and the kernel spreads connections —
+    eventually both workers serve traffic."""
+    a1, a2 = _EchoApp(marker="1"), _EchoApp(marker="2")
+    s1, t1 = _start(a1, reuse_port=True)
+    port = s1.server_address[1]
+    s2, t2 = _start(a2, port=port, reuse_port=True)
+    try:
+        seen = set()
+        deadline = time.monotonic() + 20.0
+        while len(seen) < 2 and time.monotonic() < deadline:
+            with socket.create_connection(
+                    ("127.0.0.1", port), timeout=5) as s:
+                s.sendall(_req_bytes(method="GET", target="/", body=None))
+                _, body, _ = _recv_one_response(s)
+                seen.add(body.decode())
+        assert seen == {"1", "2"}, (
+            f"kernel never spread connections across both workers: {seen}"
+        )
+    finally:
+        s1.shutdown()
+        s1.server_close()
+        s2.shutdown()
+        s2.server_close()

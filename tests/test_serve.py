@@ -1361,6 +1361,97 @@ def test_quality_feed_failure_quarantined_not_fatal(
     assert len(disabled) == 1 and "finite" in disabled[0]["error"]
 
 
+def test_warmup_failure_releases_port_for_immediate_rebind(stacking_params):
+    """Satellite (listener lifecycle): a make_server whose warmup fails
+    must release the bound port on the way out — the next bind of the
+    SAME port (e.g. a supervised worker replacement) succeeds instead of
+    EADDRINUSE. Holds per worker in multi-worker mode by construction
+    (each worker runs this exact path)."""
+    import socket as socketmod
+
+    from machine_learning_replications_tpu.resilience import faults
+
+    s = socketmod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    faults.arm("engine.warmup:raise@once")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            make_server(stacking_params, port=port, buckets=(1,))
+    finally:
+        faults.reset()
+    # the port is free NOW — a fresh server binds it without retry
+    handle = make_server(
+        stacking_params, port=port, buckets=(1,), warmup=False,
+    )
+    try:
+        assert handle.address[1] == port
+    finally:
+        handle.shutdown()
+
+
+def test_loadgen_connections_keepalive_artifact(served, tmp_path):
+    """Satellite: --connections N drives the single-threaded event-loop
+    client over N persistent keep-alive connections and records reuse
+    stats — connections opened ≈ N (sockets really persisted) and many
+    requests per connection."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    _, url = served
+    out = tmp_path / "SERVE_BENCH_conns.json"
+    rc = loadgen.main([
+        "--url", url, "--connections", "16", "--duration", "1.5",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["n_ok"] > 0 and art["n_err"] == 0
+    conns = art["connections"]
+    assert conns["client"] == "event-loop"
+    assert conns["n_connections"] == 16
+    # persistent connections persisted: no reconnect churn, several
+    # requests rode each socket
+    assert conns["opened_total"] == 16
+    assert conns["reconnects"] == 0
+    assert conns["requests_total"] == art["n_sent"]
+    assert conns["requests_per_connection_mean"] > 1
+    # thread-mode closed loop records the block too
+    out2 = tmp_path / "SERVE_BENCH_threads.json"
+    assert loadgen.main([
+        "--url", url, "--mode", "closed", "--concurrency", "2",
+        "--duration", "1.0", "--out", str(out2),
+    ]) == 0
+    art2 = json.loads(out2.read_text())
+    assert art2["connections"]["n_connections"] == 2
+    assert art2["connections"]["requests_per_connection_mean"] > 1
+
+
+def test_worker_identity_on_healthz_and_metrics(stacking_params):
+    """Multi-worker attribution: a worker-id-carrying server reports the
+    id on /healthz and exports serve_worker_info{worker=...} so scrapes
+    through the shared SO_REUSEPORT port stay attributable."""
+    handle = make_server(
+        stacking_params, port=0, buckets=(1,), warmup=False,
+        reuse_port=True, worker_id=3,
+    ).start_background()
+    try:
+        host, port = handle.address
+        _, body = _get(f"http://{host}:{port}/healthz")
+        assert json.loads(body)["worker"] == 3
+        _, page = _get(f"http://{host}:{port}/metrics")
+        assert 'serve_worker_info{worker="3"} 1' in page
+    finally:
+        handle.shutdown()
+
+
 def test_make_server_rejects_mismatched_profile_width(stacking_params):
     """A profile built over the wrong space (e.g. pre-selection 64-column
     rows attached to a bare 17-column ensemble) must fail at startup, not

@@ -5,13 +5,22 @@ Drives a running ``python -m machine_learning_replications_tpu serve``
 instance over HTTP (stdlib urllib + threads, no dependencies) in either of
 the two canonical load models:
 
-  closed loop   --concurrency N workers, each firing its next request the
-                moment the previous reply lands — measures sustainable
-                throughput at a fixed multiprogramming level.
+  closed loop   --concurrency N workers (alias: --connections N), each
+                firing its next request the moment the previous reply
+                lands — measures sustainable throughput at a fixed
+                multiprogramming level. Every worker holds ONE persistent
+                keep-alive connection and reuses it across requests (no
+                per-request TCP handshake in the measured latency); the
+                artifact's ``connections`` block records how well reuse
+                held up (connections opened vs requests sent,
+                reconnects). This is the high-concurrency mode the
+                event-loop transport is benched with — 1000 workers is
+                1000 parked sockets on the server, not 1000 threads.
   open loop     --qps R with a global schedule of send times — measures
                 behavior under an *offered* rate the server cannot slow
                 down, which is what exposes admission-control shedding
-                (closed loops self-throttle and hide it).
+                (closed loops self-throttle and hide it). One fresh
+                connection per request by construction.
 
 Every request POSTs a 17-variable patient JSON (the ``predict_hf.py:5-27``
 example by default, ``--patient`` for a file, ``--patients`` for a JSONL
@@ -56,14 +65,17 @@ Example:
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import os
 import random
 import re
+import socket
 import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 _PERTURB_TERM_RE = re.compile(
@@ -267,6 +279,402 @@ class _RetryPolicy:
 _NO_RETRY = _RetryPolicy(0)
 
 
+def _classify(code: int) -> str:
+    """HTTP status → the artifact's outcome taxonomy, shared by every
+    client engine (thread, keep-alive, event loop): 200 ok, 503 shed
+    (the explicit admission/degraded-mode contract), anything else err."""
+    return "ok" if code == 200 else "shed" if code == 503 else "err"
+
+
+def _plan_retry(retry, status, attempt, retry_after, now, stop_at,
+                tally) -> float | None:
+    """The shed-retry policy, shared by every client engine: returns the
+    backoff seconds when the request should be re-attempted, or None when
+    the outcome is final — counting the give-up when a retry budget
+    existed but was exhausted or the backoff would cross the run deadline
+    (retries respect --duration; see _fire)."""
+    if status != "shed":
+        return None
+    if attempt < retry.retries:
+        sleep_s = retry.sleep_s(attempt, retry_after)
+        if stop_at is None or now + sleep_s <= stop_at:
+            with tally.lock:
+                tally.n_retries += 1
+            return sleep_s
+    if retry.retries > 0:
+        with tally.lock:
+            tally.n_gaveup += 1
+    return None
+
+
+class _KeepAliveClient:
+    """One worker's persistent HTTP/1.1 connection, reused across
+    requests. A transport-level failure on a REUSED connection gets one
+    transparent resend on a fresh connection (the server may have
+    legitimately reaped it as idle between requests — and /predict is a
+    pure function, so a resend cannot double-apply anything); the
+    reconnect is counted so the artifact shows how well reuse held up."""
+
+    def __init__(self, url: str, timeout: float) -> None:
+        u = urllib.parse.urlparse(url)
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.timeout = timeout
+        self.conn: http.client.HTTPConnection | None = None
+        self.requests_on_conn = 0
+        self.connections_opened = 0
+        self.requests_sent = 0
+        self.reconnects = 0
+
+    def _open(self) -> None:
+        self.conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+        self.conn.connect()
+        self.connections_opened += 1
+        self.requests_on_conn = 0
+
+    def close(self) -> None:
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:
+                pass
+            self.conn = None
+
+    def _once(self, body: bytes):
+        self.conn.request(
+            "POST", "/predict", body=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = self.conn.getresponse()
+        resp.read()  # drain so the connection is reusable
+        self.requests_on_conn += 1
+        self.requests_sent += 1
+        if resp.getheader("Connection", "").lower() == "close" or \
+                resp.will_close:
+            self.close()
+        return resp
+
+    def post_predict(self, body: bytes):
+        """(status, x_request_id, retry_after) — raises on transport
+        errors (after the one fresh-connection resend)."""
+        if self.conn is None:
+            self._open()
+            resp = self._once(body)
+        else:
+            try:
+                resp = self._once(body)
+            except (http.client.HTTPException, OSError):
+                # The reused socket died under us (idle reap race, server
+                # restart): one resend on a fresh connection.
+                self.close()
+                self.reconnects += 1
+                self._open()
+                resp = self._once(body)
+        return (
+            resp.status,
+            resp.getheader("X-Request-Id"),
+            resp.getheader("Retry-After"),
+        )
+
+
+def _fire_keepalive(
+    client: _KeepAliveClient, bodies: _Bodies, tally: _Tally,
+    retry: _RetryPolicy = _NO_RETRY, stop_at: float | None = None,
+) -> None:
+    """One logical request over the worker's persistent connection —
+    same outcome taxonomy and retry semantics as ``_fire``."""
+    body = bodies.next_body()
+    attempt = 0
+    t0 = time.monotonic()
+    while True:
+        rid = retry_after = None
+        try:
+            code, rid, retry_after = client.post_predict(body)
+            status = _classify(code)
+        except Exception:
+            status = "err"
+        now = time.monotonic()
+        latency_ms = (now - t0) * 1000.0
+        sleep_s = _plan_retry(
+            retry, status, attempt, retry_after, now, stop_at, tally
+        )
+        if sleep_s is not None:
+            time.sleep(sleep_s)
+            attempt += 1
+            continue
+        tally.record(status, latency_ms, rid)
+        return
+
+
+# ---------------------------------------------------------------------------
+# event-loop closed loop (--connections): one thread, N persistent sockets
+# ---------------------------------------------------------------------------
+
+
+class _EvConn:
+    """One closed-loop connection driven by the client event loop: fires
+    its next request the moment the previous reply lands, parses replies
+    incrementally (status line + headers + Content-Length body), and
+    carries its own retry/backoff state."""
+
+    __slots__ = (
+        "sock", "buf", "t0", "attempt", "body", "requests_done",
+        "connections_opened", "reconnects", "deadline", "backoff_until",
+        "pending_new", "next_at", "closed",
+    )
+
+    def __init__(self) -> None:
+        self.sock = None
+        self.buf = bytearray()
+        self.t0 = 0.0          # first-attempt send time of the logical req
+        self.attempt = 0
+        self.body = b""
+        self.requests_done = 0
+        self.connections_opened = 0
+        self.reconnects = 0
+        self.deadline = 0.0    # per-attempt reply deadline
+        self.backoff_until = 0.0
+        self.pending_new = False  # the deferred send is a NEW logical req
+        self.next_at = 0.0     # paced mode: earliest next logical send
+        self.closed = False
+
+    def parse_reply(self):
+        """(status, headers) when a complete reply is buffered, else
+        None; consumes the reply's bytes. Raises on a garbled stream."""
+        end = self.buf.find(b"\r\n\r\n")
+        if end < 0:
+            return None
+        head = bytes(self.buf[:end]).decode("latin-1").split("\r\n")
+        status = int(head[0].split()[1])
+        headers = {}
+        for line in head[1:]:
+            name, _, value = line.partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", "0"))
+        if len(self.buf) - (end + 4) < length:
+            return None
+        del self.buf[:end + 4 + length]
+        return status, headers
+
+
+def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
+                      retry=_NO_RETRY, rate_per_conn: float = 0.0):
+    """Closed loop over ``connections`` persistent sockets driven by ONE
+    selector thread — the client-side mirror of the server's event-loop
+    transport. A thread-per-connection client melts into GIL scheduling
+    noise near a thousand threads, inflating measured latency with
+    client-side queueing; one loop keeps the client honest at the
+    concurrency the transport bench needs. Retry backoff becomes a
+    per-connection timer instead of a sleeping thread.
+
+    ``rate_per_conn`` > 0 paces each connection at that many logical
+    requests per second (think time), start times staggered across
+    connections: the 1000-user SLO scenario — 1000 live keep-alive
+    connections offering connections×rate qps — instead of the
+    zero-think-time saturation mode, whose latency is pinned at
+    N/throughput by Little's law no matter how fast the server is."""
+    import selectors
+
+    u = urllib.parse.urlparse(url)
+    addr = (u.hostname or "127.0.0.1", u.port or 80)
+    sel = selectors.DefaultSelector()
+    t_start = time.monotonic()
+    bodies.arm(t_start)
+    stop = t_start + duration
+    interval = 1.0 / rate_per_conn if rate_per_conn > 0 else 0.0
+    conns = [_EvConn() for _ in range(connections)]
+    if interval:
+        for i, c in enumerate(conns):
+            # Staggered starts decorrelate the fleet (no thundering herd
+            # at t=0 and none at each subsequent tick).
+            c.next_at = t_start + interval * i / max(connections, 1)
+
+    def connect(c: _EvConn) -> None:
+        c.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # Blocking connect, non-blocking after: loopback establishment is
+        # microseconds, and it keeps the send below well-defined.
+        c.sock.settimeout(min(timeout, 10.0))
+        c.sock.connect(addr)
+        c.sock.setblocking(False)
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        c.connections_opened += 1
+        c.buf.clear()
+
+    def unregister(c: _EvConn) -> None:
+        if c.sock is not None:
+            try:
+                sel.unregister(c.sock)
+            except (KeyError, ValueError):
+                pass
+
+    def drop_socket(c: _EvConn) -> None:
+        unregister(c)
+        if c.sock is not None:
+            c.sock.close()
+            c.sock = None
+
+    def send_request(c: _EvConn, new_logical: bool) -> None:
+        now = time.monotonic()
+        if new_logical:
+            c.body = bodies.next_body()
+            c.t0 = now
+            c.attempt = 0
+            if interval:
+                c.next_at = max(c.next_at + interval, now)
+        c.deadline = now + timeout
+        req = (
+            b"POST /predict HTTP/1.1\r\n"
+            b"Host: %b\r\nContent-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n%b"
+            % (addr[0].encode(), len(c.body), c.body)
+        )
+        # A ~700-byte request fits any socket buffer, so a short write
+        # means the connection is effectively dead: one retry on a fresh
+        # socket (counted as a reconnect), then give the request up.
+        for attempt in range(2):
+            try:
+                if c.sock is None:
+                    connect(c)
+                if c.sock.send(req) < len(req):
+                    raise OSError("short write")
+                sel.register(c.sock, selectors.EVENT_READ, c)
+                return
+            except KeyError:
+                return  # already registered (reused keep-alive socket)
+            except OSError:
+                drop_socket(c)
+                if attempt == 0:
+                    c.reconnects += 1
+        tally.record("err", (time.monotonic() - c.t0) * 1000.0, None)
+        c.requests_done += 1
+        c.closed = True
+
+    def finish(c: _EvConn, status: str, rid, retry_after) -> None:
+        """A reply (or terminal failure) for the logical request."""
+        now = time.monotonic()
+        latency_ms = (now - c.t0) * 1000.0
+        sleep_s = _plan_retry(
+            retry, status, c.attempt, retry_after, now, stop, tally
+        )
+        if sleep_s is not None:
+            # Backoff as a per-connection timer, not a sleeping thread.
+            c.attempt += 1
+            c.backoff_until = now + sleep_s
+            c.pending_new = False
+            unregister(c)
+            return
+        tally.record(status, latency_ms, rid)
+        c.requests_done += 1
+        if now < stop:
+            if interval and c.next_at > now:
+                # Paced mode: the connection idles (still connected, still
+                # keep-alive) until its next scheduled request.
+                c.backoff_until = c.next_at
+                c.pending_new = True
+            else:
+                send_request(c, new_logical=True)
+        else:
+            unregister(c)
+            if c.sock is not None:
+                c.sock.close()
+                c.sock = None
+            c.closed = True
+
+    for c in conns:
+        if interval and c.next_at > t_start:
+            c.backoff_until = c.next_at
+            c.pending_new = True
+        else:
+            send_request(c, new_logical=True)
+    while True:
+        now = time.monotonic()
+        live = [c for c in conns if not c.closed]
+        if not live:
+            break
+        for key, _ in sel.select(timeout=0.05):
+            c = key.data
+            try:
+                data = c.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                # Server closed (idle-reap race / restart) or the socket
+                # died mid-reply: one transparent resend on a fresh
+                # socket — /predict is a pure function, a resend cannot
+                # double-apply anything. A paced connection reaped while
+                # IDLE has nothing in flight: just reconnect at its next
+                # scheduled send.
+                drop_socket(c)
+                c.reconnects += 1
+                if not c.backoff_until:
+                    send_request(c, new_logical=False)
+                continue
+            c.buf += data
+            try:
+                reply = c.parse_reply()
+            except (ValueError, IndexError):
+                drop_socket(c)
+                c.reconnects += 1
+                send_request(c, new_logical=False)
+                continue
+            if reply is None:
+                continue
+            code, headers = reply
+            status = _classify(code)
+            if headers.get("connection", "").lower() == "close":
+                unregister(c)
+                c.sock.close()
+                c.sock = None
+            finish(
+                c, status, headers.get("x-request-id"),
+                headers.get("retry-after"),
+            )
+        now = time.monotonic()
+        for c in conns:
+            if c.closed:
+                continue
+            if c.backoff_until and now >= c.backoff_until:
+                c.backoff_until = 0.0
+                new = c.pending_new
+                c.pending_new = False
+                if new and now >= stop:
+                    c.closed = True
+                    drop_socket(c)
+                    continue
+                send_request(c, new_logical=new)
+            elif c.sock is not None and not c.backoff_until \
+                    and now > c.deadline:
+                # Reply deadline missed: an explicit err outcome, never a
+                # hang — and the half-dead socket is not reused.
+                tally.record("err", (now - c.t0) * 1000.0, None)
+                c.requests_done += 1
+                drop_socket(c)
+                if now < stop:
+                    send_request(c, new_logical=True)
+                else:
+                    c.closed = True
+    sel.close()
+    wall = time.monotonic() - t_start
+    sent = [c.requests_done for c in conns]
+    stats = {
+        "client": "event-loop",
+        "n_connections": connections,
+        "opened_total": sum(c.connections_opened for c in conns),
+        "reconnects": sum(c.reconnects for c in conns),
+        "requests_total": sum(sent),
+        "requests_per_connection_mean": (
+            round(sum(sent) / max(sum(c.connections_opened
+                                      for c in conns), 1), 2)
+        ),
+        "requests_on_final_connection_max": max(sent, default=0),
+    }
+    return wall, stats
+
+
 def _fire(
     url: str, bodies: _Bodies, timeout: float, tally: _Tally,
     retry: _RetryPolicy = _NO_RETRY, stop_at: float | None = None,
@@ -288,54 +696,72 @@ def _fire(
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 resp.read()
                 rid = resp.headers.get("X-Request-Id")
-                status = "ok" if resp.status == 200 else "err"
+                status = _classify(resp.status)
         except urllib.error.HTTPError as exc:
             exc.read()
             rid = exc.headers.get("X-Request-Id")
             retry_after = exc.headers.get("Retry-After")
-            status = "shed" if exc.code == 503 else "err"
+            status = _classify(exc.code)
         except Exception:
             status = "err"
-        latency_ms = (time.monotonic() - t0) * 1000.0
-        if status == "shed" and attempt < retry.retries:
-            sleep_s = retry.sleep_s(attempt, retry_after)
-            # Retries respect the run deadline: a backoff (Retry-After
-            # can be tens of seconds under a slow restart schedule) that
-            # would sleep past --duration becomes a give-up, or workers
-            # could overrun the window by minutes and skew wall/qps.
-            if stop_at is not None and time.monotonic() + sleep_s > stop_at:
-                with tally.lock:
-                    tally.n_gaveup += 1
-                tally.record(status, latency_ms, rid)
-                return
-            with tally.lock:
-                tally.n_retries += 1
+        now = time.monotonic()
+        latency_ms = (now - t0) * 1000.0
+        # Retries respect the run deadline (_plan_retry): a backoff
+        # (Retry-After can be tens of seconds under a slow restart
+        # schedule) that would sleep past --duration becomes a give-up,
+        # or workers could overrun the window by minutes and skew
+        # wall/qps.
+        sleep_s = _plan_retry(
+            retry, status, attempt, retry_after, now, stop_at, tally
+        )
+        if sleep_s is not None:
             time.sleep(sleep_s)
             attempt += 1
             continue
-        if status == "shed" and retry.retries > 0:
-            with tally.lock:
-                tally.n_gaveup += 1
         tally.record(status, latency_ms, rid)
         return
 
 
 def run_closed(url, bodies, duration, concurrency, timeout, tally,
                retry=_NO_RETRY):
+    """Closed loop over ``concurrency`` persistent keep-alive connections
+    (one per worker). Returns (wall_s, connection_stats)."""
     t0 = time.monotonic()
     bodies.arm(t0)
     stop = t0 + duration
+    clients = [_KeepAliveClient(url, timeout) for _ in range(concurrency)]
 
-    def worker():
-        while time.monotonic() < stop:
-            _fire(url, bodies, timeout, tally, retry=retry, stop_at=stop)
+    def worker(client):
+        try:
+            while time.monotonic() < stop:
+                _fire_keepalive(
+                    client, bodies, tally, retry=retry, stop_at=stop
+                )
+        finally:
+            client.close()
 
-    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    threads = [
+        threading.Thread(target=worker, args=(c,)) for c in clients
+    ]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    return time.monotonic() - t0
+    wall = time.monotonic() - t0
+    reused = [c.requests_on_conn for c in clients]
+    sent = [c.requests_sent for c in clients]
+    stats = {
+        "n_connections": concurrency,
+        "opened_total": sum(c.connections_opened for c in clients),
+        "reconnects": sum(c.reconnects for c in clients),
+        "requests_total": sum(sent),
+        "requests_per_connection_mean": (
+            round(sum(sent) / max(sum(c.connections_opened
+                                      for c in clients), 1), 2)
+        ),
+        "requests_on_final_connection_max": max(reused, default=0),
+    }
+    return wall, stats
 
 
 def run_open(url, bodies, duration, qps, timeout, tally):
@@ -381,7 +807,23 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
     ap.add_argument("--duration", type=float, default=10.0, help="seconds")
     ap.add_argument(
-        "--concurrency", type=int, default=8, help="closed-loop workers"
+        "--concurrency", type=int, default=8,
+        help="closed-loop workers (one persistent connection each)",
+    )
+    ap.add_argument(
+        "--connections", type=int, default=None, metavar="N",
+        help="high-concurrency closed-loop mode: N persistent keep-alive "
+        "connections driven by ONE event-loop thread (overrides "
+        "--concurrency; closed mode only) — the 1000-connection "
+        "transport bench knob",
+    )
+    ap.add_argument(
+        "--rate-per-conn", type=float, default=0.0, metavar="R",
+        help="pace each --connections connection at R requests/s with "
+        "staggered starts (think time): offered rate = N x R over N live "
+        "keep-alive connections — the SLO scenario; 0 (default) is "
+        "zero-think-time saturation, whose latency is pinned at "
+        "N/throughput by Little's law",
     )
     ap.add_argument("--qps", type=float, default=100.0, help="open-loop rate")
     ap.add_argument("--timeout", type=float, default=30.0)
@@ -430,6 +872,16 @@ def main(argv=None) -> int:
         # the offered qps the open loop exists to guarantee.
         ap.error("--retries requires --mode closed (an open loop that "
                  "backs off is no longer an open loop)")
+    if args.connections is not None:
+        if args.mode != "closed":
+            ap.error("--connections requires --mode closed (the open "
+                     "loop opens one connection per request by design)")
+        if args.connections < 1:
+            ap.error("--connections must be >= 1")
+        args.concurrency = args.connections
+    if args.rate_per_conn and not args.connections:
+        ap.error("--rate-per-conn requires --connections (pacing is a "
+                 "property of the event-loop client)")
 
     if args.patients:
         with open(args.patients) as f:
@@ -462,12 +914,28 @@ def main(argv=None) -> int:
         cap_ms=args.retry_cap_ms,
     )
     tally = _Tally()
+    conn_stats = None
     if args.mode == "closed":
-        wall = run_closed(
-            args.url, bodies, args.duration, args.concurrency, args.timeout,
-            tally, retry=retry,
-        )
-        offered = None
+        # --connections selects the single-threaded event-loop client:
+        # at hundreds-to-thousands of connections a thread per worker
+        # measures the client's own GIL scheduling, not the server.
+        if args.connections:
+            wall, conn_stats = run_closed_evloop(
+                args.url, bodies, args.duration, args.concurrency,
+                args.timeout, tally, retry=retry,
+                rate_per_conn=args.rate_per_conn,
+            )
+            # Paced mode has a definite offered rate; saturation does not.
+            offered = (
+                round(args.concurrency * args.rate_per_conn, 1)
+                if args.rate_per_conn else None
+            )
+        else:
+            wall, conn_stats = run_closed(
+                args.url, bodies, args.duration, args.concurrency,
+                args.timeout, tally, retry=retry,
+            )
+            offered = None
     else:
         wall = run_open(
             args.url, bodies, args.duration, args.qps, args.timeout, tally
@@ -493,6 +961,11 @@ def main(argv=None) -> int:
             for k, v in _percentiles(tally.ok_latency_ms).items()
         },
         "worst_requests": tally.worst_requests(),
+        # Keep-alive reuse accounting (closed loop): opened_total near
+        # n_connections means persistent connections really persisted;
+        # reconnects counts idle-reap races absorbed by a fresh-socket
+        # resend. Null in open-loop mode.
+        "connections": conn_stats,
         # Client-side resilience: how many sheds the retry policy absorbed
         # (n_shed counts only FINAL sheds — each one a give-up when
         # retries were on). Null when retries are disabled.
