@@ -263,9 +263,61 @@ def _run_predict(args) -> int:
     return 0
 
 
+def _xla_cpu_intra_op_default(requested: int | None) -> int | None:
+    """Satellite (ISSUE 7): a sane XLA intra-op thread default for CPU
+    serving. The r11 campaign measured the default Eigen pool bursting
+    across every core per flush and starving the event loop — with a
+    small explicit pool every repeat holds 950+ qps where the default
+    swings 670–1070. Applied via XLA_FLAGS, so it must run BEFORE jax is
+    imported (and before the multi-worker fork, so children inherit it);
+    returns the thread count actually applied (journaled in the serve
+    manifest), or None when it could not or should not be applied — jax
+    already imported (in-process callers), the operator already set the
+    knobs in XLA_FLAGS, or an explicit 0 asked to leave XLA alone."""
+    if requested is not None and requested < 0:
+        raise SystemExit("--xla-intra-op-threads must be >= 0")
+    if requested == 0:
+        return None
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "intra_op_parallelism_threads" in flags or \
+            "xla_cpu_multi_thread_eigen" in flags:
+        return None  # operator knows best
+    if "jax" in sys.modules:
+        # Too late: XLA read its flags at backend init. Honest no-op.
+        if requested:
+            print(
+                "--xla-intra-op-threads ignored: jax already initialized "
+                "in this process", file=sys.stderr,
+            )
+        return None
+    cpus = os.cpu_count() or 2
+    n = requested if requested else max(1, min(4, cpus // 2))
+    # The exact incantation BENCH.md r11 measured: a bounded pool (single
+    # thread on small hosts) instead of one burst across every core.
+    os.environ["XLA_FLAGS"] = (flags + " " if flags else "") + (
+        "--xla_cpu_multi_thread_eigen="
+        + ("false" if n == 1 else "true")
+        + f" intra_op_parallelism_threads={n}"
+    )
+    return n
+
+
 def cmd_serve(args) -> int:
     """Micro-batched HTTP inference serving (docs/SERVING.md)."""
     worker_id = getattr(args, "_worker_id", None)
+    if not hasattr(args, "_xla_threads"):
+        # Before the fork AND before any jax import: every worker
+        # inherits one consistent XLA thread policy.
+        args._xla_threads = _xla_cpu_intra_op_default(
+            args.xla_intra_op_threads
+        )
+        if args._xla_threads is not None:
+            print(
+                f"xla cpu intra-op threads: {args._xla_threads} "
+                "(override with --xla-intra-op-threads, 0 leaves XLA "
+                "alone)",
+                file=sys.stderr,
+            )
     if args.workers > 1 and worker_id is None:
         return _run_multiworker(args)
     buckets = tuple(int(b) for b in args.buckets.split(","))
@@ -301,13 +353,22 @@ def cmd_serve(args) -> int:
         "workers": args.workers,
         "idle_timeout_s": args.idle_timeout,
         "max_connections": args.max_connections,
+        "host_path": not args.no_host_path,
+        "host_workers": args.host_workers,
+        # The thread count actually applied (None: left to XLA/operator)
+        # — the bench-reproducibility knob r11 flagged, journaled so an
+        # artifact can state the pool it ran under.
+        "xla_intra_op_threads": args._xla_threads,
     }, sort_keys=True)
-    extra = (
-        {"worker": worker_id, "workers": args.workers}
-        if worker_id is not None else None
-    )
+    extra = {}
+    if worker_id is not None:
+        extra.update(worker=worker_id, workers=args.workers)
+    if args._xla_threads is not None:
+        # Readable in the manifest, not just folded into config_hash: a
+        # bench artifact must be able to STATE the pool it ran under.
+        extra["xla_intra_op_threads"] = args._xla_threads
     with _observed(args, "serve", config_json=serve_cfg,
-                   manifest_extra=extra):
+                   manifest_extra=extra or None):
         return _run_serve(args, buckets)
 
 
@@ -462,6 +523,11 @@ def _run_serve(args, buckets) -> int:
         # SO_REUSEPORT; the kernel spreads connections across them.
         reuse_port=args.workers > 1,
         worker_id=getattr(args, "_worker_id", None),
+        # Dual-path scoring is the production default: singles on an
+        # idle server answer from the host fast path at single-digit-ms
+        # p50, bursts coalesce into device micro-batches.
+        host_path=not args.no_host_path,
+        host_workers=args.host_workers,
     )
     # Serving-process GC hygiene (the Instagram pre-fork trick): the
     # warm startup heap — jax, XLA executables, the uploaded ensemble —
@@ -642,14 +708,18 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--host", default="127.0.0.1")
     v.add_argument("--port", type=int, default=8000)
     v.add_argument(
-        "--buckets", default="1,8,64,512",
+        "--buckets", default="1,8,32,64,128,256,512",
         help="compiled batch-size ladder (comma-separated, ascending); "
-        "every request batch pads up to the next bucket so the jit cache "
-        "stays bounded at one executable per bucket",
+        "every flush runs as the cheapest covering sequence of buckets "
+        "(best-fit sub-batches instead of padding mid-size batches into "
+        "one oversized bucket) and the jit cache stays bounded at one "
+        "executable per bucket",
     )
     v.add_argument(
         "--max-batch", type=int, default=None,
-        help="micro-batch flush size (default: the largest bucket)",
+        help="micro-batch flush size (default: 64 on the CPU backend — "
+        "the BENCH.md-measured sweet spot; the largest bucket on device "
+        "backends)",
     )
     v.add_argument(
         "--max-wait-ms", type=float, default=5.0,
@@ -774,6 +844,25 @@ def build_parser() -> argparse.ArgumentParser:
         "--fault-endpoint", action="store_true",
         help="enable the guarded /debug/faults chaos endpoint without "
         "arming anything at startup",
+    )
+    v.add_argument(
+        "--no-host-path", action="store_true",
+        help="disable the adaptive host fast path (dual-path scoring, "
+        "docs/SERVING.md): every request then goes through the "
+        "micro-batcher and the device engine",
+    )
+    v.add_argument(
+        "--host-workers", type=int, default=1,
+        help="host fast-path worker threads (one in-flight single-row "
+        "score each; a busy host path routes back to the device)",
+    )
+    v.add_argument(
+        "--xla-intra-op-threads", type=int, default=None,
+        help="XLA CPU intra-op thread-pool size (default: a host-sized "
+        "value, min(4, cores/2) with a floor of 1 — the r11-measured fix "
+        "for the default pool starving the event loop; 0 leaves XLA "
+        "alone; ignored when XLA_FLAGS already sets the knobs). The "
+        "applied value is journaled in the serve manifest",
     )
     v.add_argument("--verbose", action="store_true", help="log each request")
     add_obs_flags(v)

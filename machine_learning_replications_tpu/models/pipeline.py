@@ -681,10 +681,12 @@ def impute_select(
 ) -> jnp.ndarray:
     """KNN-impute raw 64-wide rows and gather the lasso support columns —
     the front half of full-pipeline inference, ending at the member
-    ensemble's 17-column input. ``pipeline_predict_proba1`` and the
-    serving engine (``serve/engine.py``, which jits its own
-    ``stacking.predict_proba1`` call for the per-bucket compile bound)
-    both run THIS composition, so the two routes cannot drift.
+    ensemble's 17-column input. ``pipeline_predict_proba1``, the serving
+    engine (``serve/engine.py``, which jits its own
+    ``stacking.predict_proba1`` call for the per-bucket compile bound),
+    and the dual-path host scorer (``serve/hostpath.py`` — the same
+    engine pinned to the CPU backend) all run THIS composition, so none
+    of the routes can drift: parity is structural, not tested-in.
     ``block_fn`` is ``knn_impute.resolve_block_fn``'s output for callers
     with a fixed query NaN pattern (the serving hot path resolves it once
     at engine init instead of paying a device→host sync per batch)."""

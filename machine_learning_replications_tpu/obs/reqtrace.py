@@ -49,8 +49,16 @@ from machine_learning_replications_tpu.obs import spans
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 
 #: Phase names in request order (docs/OBSERVABILITY.md "Request traces").
+#: A device-path request records parse → queue_wait → batch_assembly →
+#: device_compute → respond; a host-path request (dual-path scoring,
+#: docs/SERVING.md) records parse → queue_wait (host-slot wait) →
+#: host_compute → respond. Every /predict trace carries a ``path``
+#: annotation (``host`` | ``device``) plus the router's ``path_reason``,
+#: so tail samples say not just where the time went but which engine the
+#: request was routed to and why.
 PHASES = (
-    "parse", "queue_wait", "batch_assembly", "device_compute", "respond",
+    "parse", "queue_wait", "batch_assembly", "device_compute",
+    "host_compute", "respond",
 )
 
 _ID_OK = set(
@@ -136,6 +144,19 @@ class RequestTrace:
             self.phases.update(phases)
             if meta:
                 self.meta.update(meta)
+
+    def drop_phases(self, *names: str) -> None:
+        """Remove phases from a live trace. The host→device failure
+        fallback uses this: the failed host attempt's queue_wait /
+        host_compute would otherwise overlap the device path's fresh
+        queue_wait (which restarts at parse end) and break the
+        phases-partition-the-interval invariant — the abandoned attempt's
+        time is deliberately re-attributed as device-path queueing."""
+        with self._lock:
+            if self.t_end is not None:
+                return
+            for name in names:
+                self.phases.pop(name, None)
 
     def phase_end(self, name: str, default: float) -> float:
         """End stamp of a recorded phase (``default`` when absent) — the

@@ -227,6 +227,14 @@ class SupervisedEngine:
     def bucket_for(self, n: int) -> int:
         return self._engine.bucket_for(n)
 
+    def plan_batch(self, n: int) -> tuple[int, ...]:
+        inner = getattr(self._engine, "plan_batch", None)
+        if inner is not None:
+            return inner(n)
+        # Test doubles without shaping: one covering bucket, the
+        # pre-shaping contract.
+        return (self._engine.bucket_for(n),)
+
     def compile_count(self) -> int:
         return self._engine.compile_count()
 

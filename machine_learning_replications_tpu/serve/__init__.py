@@ -38,10 +38,16 @@ generator: ``tools/loadgen.py``. Architecture notes: ``docs/SERVING.md``.
 from machine_learning_replications_tpu.serve.batcher import (
     MicroBatcher,
     Overloaded,
+    PathRouter,
 )
 from machine_learning_replications_tpu.serve.engine import (
     DEFAULT_BUCKETS,
     BucketedPredictEngine,
+)
+from machine_learning_replications_tpu.serve.hostpath import (
+    HostBusy,
+    HostPath,
+    HostScorer,
 )
 from machine_learning_replications_tpu.serve.metrics import ServingMetrics
 from machine_learning_replications_tpu.serve.server import (
@@ -52,8 +58,12 @@ from machine_learning_replications_tpu.serve.server import (
 __all__ = [
     "BucketedPredictEngine",
     "DEFAULT_BUCKETS",
+    "HostBusy",
+    "HostPath",
+    "HostScorer",
     "MicroBatcher",
     "Overloaded",
+    "PathRouter",
     "ServingMetrics",
     "ServerHandle",
     "make_server",

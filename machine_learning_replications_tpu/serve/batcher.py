@@ -18,6 +18,11 @@ contract; the shed rate is a first-class metric).
 ``close(drain=True)`` stops admission, flushes everything already
 admitted, and joins the flush thread: an admitted request is never dropped
 by shutdown.
+
+``PathRouter`` (dual-path scoring, docs/SERVING.md) also lives here: the
+routing decision is a function of batcher state — queue depth and whether
+a flush is mid-compute — plus host-path availability and the request's
+deadline, and this module owns that state.
 """
 
 from __future__ import annotations
@@ -78,6 +83,10 @@ class MicroBatcher:
         self._cv = threading.Condition()
         self._q: deque[_Pending] = deque()
         self._flush_seq = 0  # flush-thread-only; correlates traces↔flushes
+        # Routing signal (PathRouter): True while the flush thread is out
+        # of the queue lock running a batch. Written by the flush thread
+        # only; racy reads are fine — the router treats it as a hint.
+        self._flushing = False
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name="micro-batcher", daemon=True
@@ -86,7 +95,7 @@ class MicroBatcher:
 
     # -- producer side -----------------------------------------------------
 
-    def submit(self, row: np.ndarray, trace=None) -> Future:
+    def submit(self, row: np.ndarray, trace=None, count: bool = True) -> Future:
         """Enqueue one contract-order feature row; resolves to its
         probability (float). Raises ``Overloaded`` when the admission
         queue is full and ``RuntimeError`` after ``close``.
@@ -95,7 +104,10 @@ class MicroBatcher:
         thread stamps its queue-wait / batch-assembly / device-compute
         phases and flush annotations (sequence, bucket, cold-compile) —
         the batcher never *finishes* a trace; request lifecycle stays
-        with the caller."""
+        with the caller. ``count=False`` skips the ``requests_total``
+        increment: the host-path failure fallback resubmits a request
+        that was already counted at its first admission, and one logical
+        request must move the counter once."""
         row = np.asarray(row, np.float64).ravel()
         want = getattr(self._engine, "n_features", None)
         if want is not None and row.shape[0] != want:
@@ -118,7 +130,8 @@ class MicroBatcher:
             self._q.append(p)
             qlen = len(self._q)
             if self._metrics is not None:
-                self._metrics.requests_total.inc()
+                if count:
+                    self._metrics.requests_total.inc()
                 self._metrics.queue_depth.set(qlen)
             # Wake the flush thread only when it could act on the wake:
             # the first request of an empty queue (it is parked in the
@@ -134,6 +147,12 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         with self._cv:
             return len(self._q)
+
+    @property
+    def flush_in_progress(self) -> bool:
+        """Whether the flush thread is currently running a batch (hint for
+        the path router; see ``PathRouter.decide``)."""
+        return self._flushing
 
     # -- consumer side -----------------------------------------------------
 
@@ -162,7 +181,11 @@ class MicroBatcher:
                 ]
                 if self._metrics is not None:
                     self._metrics.queue_depth.set(len(self._q))
-            self._flush(batch)
+            self._flushing = True
+            try:
+                self._flush(batch)
+            finally:
+                self._flushing = False
 
     def _note_flush_phases(
         self, batch: list[_Pending], t_claim: float, t_c0: float,
@@ -204,8 +227,22 @@ class MicroBatcher:
         self._flush_seq += 1  # flush thread only — no lock needed
         flush_seq = self._flush_seq
         tracer = spans.get_tracer()
+        # Batch shape accounting: the engine's plan (the exact chunk
+        # sequence predict will run — ``engine.plan_batch``) when it has
+        # one, else the legacy single covering bucket. ``bucket`` stays
+        # the plan's largest chunk so existing trace/journal consumers
+        # keep a scalar; multi-chunk plans additionally carry ``shape``.
+        plan_for = getattr(self._engine, "plan_batch", None)
         bucket_for = getattr(self._engine, "bucket_for", None)
-        bucket = bucket_for(len(batch)) if bucket_for is not None else None
+        if plan_for is not None:
+            plan = tuple(plan_for(len(batch)))
+        elif bucket_for is not None:
+            plan = (bucket_for(len(batch)),)
+        else:
+            plan = None
+        bucket = max(plan) if plan else None
+        padded = (sum(plan) - len(batch)) if plan else 0
+        shape = list(plan) if plan and len(plan) > 1 else None
         # Cold-compile attribution: a flush that grows the engine's
         # compile count (or, failing that instrument, the process
         # compile counter) paid an XLA compile — THE canonical
@@ -282,22 +319,20 @@ class MicroBatcher:
             "flush", seq=flush_seq, rows=len(batch), ok=True,
             bucket=bucket, cold_compile=cold,
             oldest_wait_s=round(now - batch[0].t_enqueue, 6),
+            **({"shape": shape} if shape is not None else {}),
         )
         self._note_flush_phases(batch, t_claim, t_c0, t_c1, {
             "flush_seq": flush_seq, "batch_rows": len(batch),
             "bucket": bucket, "cold_compile": cold,
-            "padded_rows": (
-                max(bucket - len(batch), 0) if bucket is not None else 0
-            ),
+            "padded_rows": max(padded, 0),
+            **({"shape": shape} if shape is not None else {}),
             "flush_tid": tracer.current_tid() if tracer is not None else None,
         })
         if self._metrics is not None:
             self._metrics.batches_total.inc()
             self._metrics.batch_size.observe(len(batch))
-            if bucket is not None:
-                self._metrics.padding_waste.observe(
-                    max(bucket - len(batch), 0)
-                )
+            if plan is not None:
+                self._metrics.padding_waste.observe(max(padded, 0))
             self._metrics.latency.observe_many(
                 [now - p.t_enqueue for p in batch]
             )
@@ -322,3 +357,60 @@ class MicroBatcher:
                     self._metrics.queue_depth.set(0)
             self._cv.notify_all()
         self._thread.join(timeout)
+
+
+class PathRouter:
+    """The dual-path routing decision (docs/SERVING.md "Dual-path
+    scoring"): host fast path or device micro-batch, per request.
+
+    The policy is deliberately small and fully deterministic given the
+    observed state — every branch is unit-testable by forcing that state:
+
+      * no host path (unsupported family, disabled, not warm) → device;
+      * host saturated (every ``HostPath`` slot busy) → device — at
+        saturation the batcher's coalescing is the whole throughput
+        story, and the host path self-limits by its slot bound;
+      * a *tight* request deadline (``deadline_s`` at or under
+        ``tight_deadline_s``) → host: such a request cannot afford the
+        coalescing window plus a possibly-mid-flight flush ahead of it;
+      * queued rows already coalescing (``queue_depth`` ≥
+        ``burst_depth``) → device: joining a forming batch costs no
+        extra wait and buys the batch economics;
+      * otherwise (idle queue — even with a flush mid-compute, which a
+        new device request would serialize behind) → host.
+
+    ``decide`` returns ``(path, reason)``; the caller counts the path it
+    actually dispatched (a ``HostBusy`` race falls back to device) in
+    ``serve_path_total`` and stamps both on the request trace.
+    """
+
+    def __init__(
+        self,
+        batcher: MicroBatcher,
+        host,
+        burst_depth: int = 1,
+        tight_deadline_s: float = 0.05,
+    ) -> None:
+        if burst_depth < 1:
+            raise ValueError("burst_depth must be >= 1")
+        self.batcher = batcher
+        self.host = host
+        self.burst_depth = int(burst_depth)
+        self.tight_deadline_s = float(tight_deadline_s)
+
+    def decide(self, deadline_s: float | None = None) -> tuple[str, str]:
+        host = self.host
+        if host is None:
+            return "device", "no_host_path"
+        if not getattr(host, "available", True):
+            return "device", "host_unavailable"
+        if host.saturated:
+            return "device", "host_saturated"
+        if deadline_s is not None and deadline_s <= self.tight_deadline_s:
+            return "host", "tight_deadline"
+        depth = self.batcher.queue_depth
+        if depth >= self.burst_depth:
+            return "device", "coalescing"
+        if self.batcher.flush_in_progress:
+            return "host", "flush_in_progress"
+        return "host", "idle"

@@ -4,14 +4,31 @@
 program for every distinct batch size its batcher happens to flush — an
 unbounded compile cache and multi-second tail latencies whenever traffic
 finds a new size. The engine instead pads every batch up to a fixed ladder
-of bucket sizes (Clipper/TF-Serving practice; default ``1/8/64/512``): the
-jit cache is bounded at one executable per bucket, and ``warmup()`` pays
-every compile at startup so the first real request never does.
+of bucket sizes (Clipper/TF-Serving practice; default
+``1/8/32/64/128/256/512``): the jit cache is bounded at one executable
+per bucket, and ``warmup()`` pays every compile at startup so the first
+real request never does.
 
 Padding is row-replication (``np.pad`` edge mode). Every predict path the
 engine serves — stacking members, bare GBDT, the full pipeline — is a pure
 per-row map, so pad rows cannot perturb real rows; they cost device FLOPs,
 which ``serve.metrics`` accounts as ``padding_waste``.
+
+**Batch shaping.** Padding waste is not free: the r11 bench campaign
+measured mid-size flushes (65–200 rows) padding into the coarse ladder's
+512 bucket and burning up to 6× the needed compute. Two fixes compose
+here. The default ladder is finer (seven buckets instead of four — still
+a bounded, warmable cache), and ``plan_batch`` decomposes each flush into
+the cheapest covering sequence of ladder buckets instead of always
+padding to one: 65 rows run as a full 64-bucket call plus a 1-bucket
+call (zero pad rows) rather than padding 63 rows into 128. The plan is
+chosen by a small memoized DP minimizing ``padded_rows +
+split_penalty_rows × extra_dispatches`` — each extra compiled call costs
+real dispatch overhead (≈2 ms single-row on the bench CPU ≈ 24 rows of
+bucket-512 compute, the default penalty), so tiny batches still take one
+padded bucket and the split only wins when it saves real work. Every
+chunk is a ladder bucket, so the one-compile-per-bucket bound is
+untouched.
 
 The engine accepts the same three param families as ``cli.py predict``
 (SURVEY.md §2.3 parity oracle):
@@ -31,6 +48,7 @@ The engine accepts the same three param families as ``cli.py predict``
 from __future__ import annotations
 
 import bisect
+import functools
 import time
 from typing import Sequence
 
@@ -39,7 +57,43 @@ import numpy as np
 from machine_learning_replications_tpu.obs import jaxmon, journal, spans
 from machine_learning_replications_tpu.resilience import faults
 
-DEFAULT_BUCKETS = (1, 8, 64, 512)
+DEFAULT_BUCKETS = (1, 8, 32, 64, 128, 256, 512)
+
+#: Extra-dispatch cost of one more sub-batch, in padded-row equivalents:
+#: a single-row engine call measured ~2.1 ms on the r11 bench CPU while
+#: the 512 bucket ran ~87 µs/row, so one dispatch ≈ 24 rows of compute.
+#: A split must save at least this much padding per extra chunk to win.
+DEFAULT_SPLIT_PENALTY_ROWS = 24
+
+#: Sub-batches per flush are capped: each chunk is its own device call,
+#: and an unbounded decomposition (worst case: a run of 1-buckets) would
+#: trade padding waste for dispatch-overhead waste.
+DEFAULT_MAX_SPLIT = 4
+
+
+@functools.lru_cache(maxsize=4096)
+def _tail_plan(
+    n: int, buckets: tuple[int, ...], penalty: int, max_chunks: int
+) -> tuple[int, ...]:
+    """Cheapest covering decomposition of ``n`` rows (0 < n ≤ top bucket)
+    into ladder buckets: minimizes ``padded_rows + penalty × (chunks−1)``
+    under the chunk cap, ties broken toward fewer chunks. Full chunks come
+    first; only the final, covering chunk can pad."""
+    cover = buckets[bisect.bisect_left(buckets, n)]
+    best_plan = (cover,)
+    best_cost = cover - n
+    if max_chunks > 1:
+        for b in reversed(buckets):
+            if b >= n:
+                continue
+            sub = _tail_plan(n - b, buckets, penalty, max_chunks - 1)
+            cost = (b + sum(sub) - n) + penalty * len(sub)
+            if cost < best_cost or (
+                cost == best_cost and 1 + len(sub) < len(best_plan)
+            ):
+                best_plan = (b,) + sub
+                best_cost = cost
+    return best_plan
 
 
 class BucketedPredictEngine:
@@ -56,6 +110,8 @@ class BucketedPredictEngine:
         params,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         quality=None,
+        split_penalty_rows: int = DEFAULT_SPLIT_PENALTY_ROWS,
+        max_split: int = DEFAULT_MAX_SPLIT,
     ) -> None:
         import jax
 
@@ -66,7 +122,13 @@ class BucketedPredictEngine:
         buckets = sorted({int(b) for b in buckets})
         if not buckets or buckets[0] < 1:
             raise ValueError(f"bucket ladder must be positive ints, got {buckets!r}")
+        if split_penalty_rows < 0 or max_split < 1:
+            raise ValueError(
+                "need split_penalty_rows >= 0 and max_split >= 1"
+            )
         self.buckets = tuple(buckets)
+        self.split_penalty_rows = int(split_penalty_rows)
+        self.max_split = int(max_split)
         self.params = params
         self.trace_counts: dict[int, int] = {}
         self.warm = False
@@ -191,11 +253,34 @@ class BucketedPredictEngine:
         i = bisect.bisect_left(self.buckets, n)
         return self.buckets[min(i, len(self.buckets) - 1)]
 
+    def plan_batch(self, n: int) -> tuple[int, ...]:
+        """The bucket sequence an ``n``-row batch will actually run as:
+        whole top-bucket chunks for anything oversize, then the cheapest
+        covering decomposition of the remainder (module docstring "Batch
+        shaping"). Deterministic, so the batcher can account padding and
+        annotate traces with the exact shape ``predict`` executes.
+        ``sum(plan) − n`` is the flush's padded-row count; only the final
+        chunk pads."""
+        if n <= 0:
+            return ()
+        top = self.buckets[-1]
+        q, r = divmod(n, top)
+        plan = (top,) * q
+        if r:
+            plan += _tail_plan(
+                r, self.buckets, self.split_penalty_rows, self.max_split
+            )
+        return plan
+
     def predict(self, X: np.ndarray) -> np.ndarray:
         """P(class 1) for ``X[n, 17]`` contract-order rows; any ``n`` ≥ 0.
 
-        Batches beyond the largest bucket run as sequential largest-bucket
-        chunks — the compile cache stays bounded no matter what the
+        The batch runs as the ``plan_batch`` chunk sequence (order
+        preserving — row i of the input is row i of the result): batches
+        beyond the largest bucket become sequential top-bucket chunks,
+        and mid-size remainders split into best-fit sub-batches instead
+        of padding into one oversized bucket. Every chunk is a ladder
+        bucket, so the compile cache stays bounded no matter what the
         batcher (or a caller) hands in.
         """
         X = np.asarray(X, np.float64)
@@ -212,26 +297,49 @@ class BucketedPredictEngine:
         # is a wedged device — it burns inside the supervisor's watchdog
         # window, the canonical chaos drill. Free when nothing is armed.
         faults.fire("engine.compute")
-        top = self.buckets[-1]
-        if n > top:
-            return np.concatenate(
-                [self.predict(X[s : s + top]) for s in range(0, n, top)]
-            )
-        b = self.bucket_for(n)
-        if n < b:
-            X = np.pad(X, ((0, b - n), (0, 0)), mode="edge")
-        p1, members, qrows = self._impl(X)
-        probs = np.asarray(p1, np.float64)[:n]
+        feed = self.quality is not None
+        probs_parts: list[np.ndarray] = []
+        member_parts: list[np.ndarray] | None = [] if feed else None
+        qrow_parts: list[np.ndarray] = []
+        off = 0
+        for b in self.plan_batch(n):
+            take = min(b, n - off)
+            Xc = X[off:off + take]
+            if take < b:
+                Xc = np.pad(Xc, ((0, b - take), (0, 0)), mode="edge")
+            p1, members, qrows = self._impl(Xc)
+            probs_parts.append(np.asarray(p1, np.float64)[:take])
+            if feed:
+                # Quality-feed inputs fetched ONLY when a monitor is
+                # attached: on the pipeline route qrows/members are
+                # device arrays, and an unconditional np.asarray would
+                # bill every quality-off flush a device→host transfer.
+                # Pad rows sliced off BEFORE anything downstream sees
+                # them: edge-replicated rows would double-weight the
+                # last real patient in the drift window.
+                qrow_parts.append(np.asarray(qrows)[:take])
+                if members is None:
+                    member_parts = None
+                elif member_parts is not None:
+                    member_parts.append(
+                        np.asarray(members, np.float64)[:take]
+                    )
+            off += take
+        probs = (
+            probs_parts[0] if len(probs_parts) == 1
+            else np.concatenate(probs_parts)
+        )
         if self.quality is not None:
             try:
-                # Pad rows sliced off BEFORE the monitor sees anything:
-                # edge-replicated rows would double-weight the last real
-                # patient.
                 self.quality.observe_batch(
-                    np.asarray(qrows)[:n],
+                    qrow_parts[0] if len(qrow_parts) == 1
+                    else np.concatenate(qrow_parts),
                     probs,
-                    None if members is None
-                    else np.asarray(members, np.float64)[:n],
+                    None if member_parts is None
+                    else (
+                        member_parts[0] if len(member_parts) == 1
+                        else np.concatenate(member_parts)
+                    ),
                 )
             except Exception as exc:
                 # Telemetry must never take serving down: the prediction

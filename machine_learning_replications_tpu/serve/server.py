@@ -36,7 +36,15 @@ Endpoints:
                   otherwise — and the whole request records a per-phase
                   trace (``obs.reqtrace``): parse → queue wait → batch
                   assembly → device compute (cold-compile flagged) →
-                  respond.
+                  respond (host-path requests: parse → queue wait → host
+                  compute → respond). With dual-path scoring enabled the
+                  request is routed (``PathRouter``): host fast path for
+                  singles on an idle server, device micro-batches for
+                  bursts; the taken path is echoed as ``X-Serve-Path``
+                  (an inbound ``X-Serve-Path: host|device`` header pins
+                  it), counted in ``serve_path_total``, and a client
+                  ``X-Request-Deadline-Ms`` header tightens the reply
+                  deadline and biases routing toward the host path.
   GET  /healthz   LIVENESS (always 200 while the process can answer) plus
                   the load signal an external prober wants: params family,
                   bucket ladder, warm flag, queue depth, uptime, the run
@@ -132,15 +140,31 @@ from machine_learning_replications_tpu.resilience.supervisor import (
 from machine_learning_replications_tpu.serve.batcher import (
     MicroBatcher,
     Overloaded,
+    PathRouter,
 )
 from machine_learning_replications_tpu.serve.engine import (
     DEFAULT_BUCKETS,
     BucketedPredictEngine,
 )
+from machine_learning_replications_tpu.serve.hostpath import (
+    DEFAULT_HOST_BUCKETS,
+    HOST_FALLBACKS,
+    PATHS,
+    HostBusy,
+    HostPath,
+    HostScorer,
+)
 from machine_learning_replications_tpu.serve.metrics import ServingMetrics
 from machine_learning_replications_tpu.serve.transport import (
     EventLoopHttpServer,
 )
+
+#: On the CPU backend the r11 campaign measured mid-size flushes padding
+#: into the big buckets as pure waste; BENCH.md's recommendation — cap
+#: flushes at the cheap 64-row executable — is now the default there.
+#: Device backends keep the top bucket (big batches are the whole point
+#: of an accelerator).
+CPU_DEFAULT_MAX_BATCH = 64
 
 # predict_hf.py:38-40 — the single-patient CLI prints exactly this line;
 # the HTTP reply carries it verbatim so the serving layer inherits the
@@ -163,6 +187,7 @@ class ServerHandle:
         self, engine, batcher, metrics, httpd,
         recorder=None, slo_tracker=None, profile_dir: str | None = None,
         quality=None, worker_id: int | None = None,
+        host=None, router=None, quality_feed=None,
     ) -> None:
         self.engine = engine
         self.batcher = batcher
@@ -173,6 +198,9 @@ class ServerHandle:
         self.profile_dir = profile_dir
         self.quality = quality  # obs.quality.QualityMonitor or None
         self.worker_id = worker_id  # pre-fork multi-worker id, or None
+        self.host = host            # hostpath.HostPath or None
+        self.router = router        # batcher.PathRouter or None
+        self.quality_feed = quality_feed  # AsyncQualityFeed or None
         # Graceful-drain marker: set FIRST in shutdown so /readyz drops
         # before admission closes — a load balancer stops routing here
         # while in-flight requests finish.
@@ -200,6 +228,11 @@ class ServerHandle:
         transport. Safe to call more than once."""
         self.draining = True
         self.batcher.close(drain=drain)
+        if self.host is not None:
+            # In-flight host-path work finishes (its computes are
+            # single-digit ms); anything unclaimed fails fast — same
+            # admitted-work contract as the batcher drain.
+            self.host.close()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
@@ -208,6 +241,10 @@ class ServerHandle:
         close_engine = getattr(self.engine, "close", None)
         if close_engine is not None:  # supervised: stop the worker thread
             close_engine()
+        if self.quality_feed is not None:
+            # Drain-then-stop: rows already handed off still reach the
+            # monitor so a post-shutdown snapshot reflects all traffic.
+            self.quality_feed.close()
 
 
 class _InFlight:
@@ -215,15 +252,24 @@ class _InFlight:
     completion (any flush thread) and the deadline timer (loop thread) is
     resolved under a lock — exactly one of them replies."""
 
-    __slots__ = ("app", "trace", "responder", "future", "timer", "_done",
-                 "_lock")
+    __slots__ = ("app", "trace", "responder", "future", "timer", "path",
+                 "deadline_s", "row", "fell_back", "_done", "_lock")
 
-    def __init__(self, app, trace, responder, future) -> None:
+    def __init__(self, app, trace, responder, future, path: str = "device",
+                 deadline_s: float | None = None, row=None) -> None:
         self.app = app
         self.trace = trace
         self.responder = responder
         self.future = future
         self.timer = None
+        self.path = path
+        self.deadline_s = (
+            deadline_s if deadline_s is not None else app.request_timeout_s
+        )
+        # Host-path requests keep their row for the one-shot fallback
+        # resubmission through the device path (see on_done).
+        self.row = row
+        self.fell_back = False
         self._done = False
         self._lock = threading.Lock()
 
@@ -245,7 +291,7 @@ class _InFlight:
         # compounding the overload.
         cancelled = self.future.cancel()
         app.metrics.timeouts_total.inc()
-        msg = f"timed out after {app.request_timeout_s:g}s"
+        msg = f"timed out after {self.deadline_s:g}s"
         if cancelled:
             # Truly unclaimed: the wait WAS the request — attribute it as
             # queue time. When cancel LOSES the claim race the flush
@@ -263,8 +309,57 @@ class _InFlight:
         app._fail(self.responder, trace, "timeout", 504, msg)
 
     def on_done(self, future) -> None:
-        """The batcher resolved the future (flush thread — or inline when
-        already resolved at callback registration)."""
+        """The batcher/host pool resolved the future (flush or host-path
+        worker thread — or inline when already resolved at callback
+        registration)."""
+        exc0 = None if future.cancelled() else future.exception()
+        if exc0 is not None and self.path == "host" and self.row is not None:
+            # Host fast-path failure: ONE transparent resubmission through
+            # the device path before anything reaches the client. The
+            # supervised engine owns failure semantics — its watchdog,
+            # breaker streak, and restart machinery must see engine
+            # faults, and the host path is an optimization, not a second
+            # failure domain (a persistently broken engine then degrades
+            # exactly as it would without routing: device 500s feed the
+            # breaker, the breaker sheds, the supervisor restarts).
+            with self._lock:
+                retry = not self._done and not self.fell_back
+                if retry:
+                    self.fell_back = True
+            if retry:
+                HOST_FALLBACKS.inc()
+                self.path = "device"
+                self.trace.note(path="device",
+                                path_reason="host_error_fallback")
+                # The failed attempt's phases would overlap the device
+                # path's fresh stamps (its queue_wait restarts at parse
+                # end); drop them so the published phases still
+                # partition the request — the abandoned host time reads
+                # as queueing, which is what it was to the client.
+                self.trace.drop_phases("queue_wait", "host_compute")
+                try:
+                    # count=False: this logical request was counted at
+                    # its host admission; the resubmission must not move
+                    # requests_total again.
+                    new_future = self.app.batcher.submit(
+                        self.row, trace=self.trace, count=False
+                    )
+                except BaseException as sub_exc:
+                    if not self._claim():
+                        return
+                    if self.timer is not None:
+                        self.timer.cancel()
+                    if isinstance(sub_exc, Overloaded):
+                        self.trace.note(shed=True)
+                        self.app._fail(self.responder, self.trace, "shed",
+                                       503, "overloaded")
+                    else:
+                        self.app._fail(self.responder, self.trace, "error",
+                                       500, str(exc0))
+                    return
+                self.future = new_future
+                new_future.add_done_callback(self.on_done)
+                return
         if not self._claim():
             return  # the deadline path already answered (and cancelled us)
         if self.timer is not None:
@@ -290,10 +385,14 @@ class _InFlight:
                 app._fail(responder, trace, "error", 500, str(exc))
             return
         prob = future.result()
-        # Respond phase starts at device-compute end, so the phases
+        # Respond phase starts at compute end (device_compute for the
+        # batched path, host_compute for the fast path), so the phases
         # partition the whole server-side interval: completion-callback
         # scheduling delay is response-path latency, not dead time.
-        t_resp0 = trace.phase_end("device_compute", time.perf_counter())
+        t_resp0 = trace.phase_end(
+            "device_compute",
+            trace.phase_end("host_compute", time.perf_counter()),
+        )
         try:
             # Faultpoint on the respond path: an injected fault here drops
             # the connection with NOTHING written — the client sees an
@@ -312,7 +411,11 @@ class _InFlight:
         responder.send_json(200, {
             "probability": prob,
             "text": OUTPUT_CONTRACT.format(100.0 * prob),
-        }, request_id=trace.request_id)
+        }, request_id=trace.request_id,
+            # The taken path rides every reply so clients (loadgen's
+            # `paths` block) can account the routing split without a
+            # /metrics scrape.
+            headers={"X-Serve-Path": self.path})
         trace.add_phase("respond", t_resp0, time.perf_counter())
         trace.finish("ok")
         if app.slo_tracker is not None:
@@ -338,6 +441,8 @@ class _App:
         self.engine = handle.engine
         self.recorder = handle.recorder
         self.slo_tracker = handle.slo_tracker
+        self.host = handle.host          # HostPath or None
+        self.router = handle.router      # PathRouter or None
 
     # -- transport interface -----------------------------------------------
 
@@ -437,6 +542,10 @@ class _App:
                 "buckets": list(engine.buckets),
                 "warm": engine.warm,
                 "queue_depth": self.batcher.queue_depth,
+                # Dual-path scoring: whether the host fast path is live
+                # (the per-path traffic split is serve_path_total on
+                # /metrics and the per-reply X-Serve-Path header).
+                "host_path": handle.host is not None,
                 "uptime_seconds": round(
                     time.time() - self.metrics.started_at, 3
                 ),
@@ -473,6 +582,16 @@ class _App:
                     "no reference profile in the served params "
                     "(or started with --no-quality)"
                 ))
+            elif handle.quality_feed is not None:
+                # Async feed: drain what is already handed off so a
+                # snapshot taken right after traffic reflects that
+                # traffic. The bounded wait runs on its own short-lived
+                # thread (the /debug/profile pattern) — the event loop
+                # must never block behind the feed.
+                threading.Thread(
+                    target=self._quality_snapshot, args=(rsp,),
+                    name="serve-quality-snap", daemon=True,
+                ).start()
             else:
                 rsp.send_json(200, handle.quality.snapshot(detail=True))
         elif path == "/debug/requests":
@@ -519,6 +638,15 @@ class _App:
                 )
         else:
             rsp.send_json(404, {"error": f"no such path: {path}"})
+
+    def _quality_snapshot(self, rsp) -> None:
+        try:
+            self.handle.quality_feed.drain(timeout=2.0)
+            snap = self.handle.quality.snapshot(detail=True)
+        except Exception as exc:
+            rsp.send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        rsp.send_json(200, snap)
 
     def _profile_capture(self, seconds: float, rsp) -> None:
         try:
@@ -624,21 +752,73 @@ class _App:
                 headers=_retry_after(self.engine.retry_after_s()),
             )
             return
-        try:
-            future = self.batcher.submit(row[0], trace=trace)
-        except Overloaded:
-            trace.note(shed=True)
-            self._fail(rsp, trace, "shed", 503, "overloaded")
-            return
-        except RuntimeError as exc:  # closed during shutdown
-            self._fail(rsp, trace, "shed", 503, str(exc))
-            return
-        ctx = _InFlight(self, trace, rsp, future)
+        # Per-request deadline: the server-wide --request-timeout, tightened
+        # by an optional client X-Request-Deadline-Ms header (never
+        # loosened — the server's bound is the contract). The router sees
+        # the effective value: a tight deadline is a routing signal.
+        deadline_s = self.request_timeout_s
+        raw_deadline = req.get_header("x-request-deadline-ms")
+        if raw_deadline:
+            try:
+                client_s = float(raw_deadline) / 1000.0
+            except ValueError:
+                client_s = 0.0
+            if client_s > 0.0:
+                deadline_s = min(deadline_s, client_s)
+        # Dual-path routing (PathRouter, docs/SERVING.md): host fast path
+        # for singles on an idle server, device micro-batches for bursts.
+        # A HostBusy race (a slot vanished between decide and submit)
+        # falls back to the device path; the counted path is the one the
+        # request actually took. An inbound X-Serve-Path header pins the
+        # request (device: always honored — the drill/bench escape hatch
+        # for exercising the supervised engine directly; host: honored
+        # when the fast path can take it) — pinning selects an execution
+        # strategy, both of which serve the same bits.
+        pin = (req.get_header("x-serve-path") or "").strip().lower()
+        if self.router is None:
+            path, reason = "device", "no_host_path"
+        elif pin == "device":
+            path, reason = "device", "client_pinned"
+        elif pin == "host":
+            # A zero deadline makes decide() prefer the host whenever it
+            # can take the request; saturation/unavailability still fall
+            # back with their own reason.
+            path, reason = self.router.decide(0.0)
+            if path == "host":
+                reason = "client_pinned"
+        else:
+            path, reason = self.router.decide(deadline_s)
+        future = None
+        if path == "host":
+            try:
+                future = self.host.submit(row[0], trace=trace)
+                self.metrics.requests_total.inc()
+            except HostBusy:
+                path, reason = "device", "host_saturated"
+            except RuntimeError as exc:  # closed during shutdown
+                self._fail(rsp, trace, "shed", 503, str(exc))
+                return
+        if future is None:
+            try:
+                future = self.batcher.submit(row[0], trace=trace)
+            except Overloaded:
+                trace.note(shed=True)
+                self._fail(rsp, trace, "shed", 503, "overloaded")
+                return
+            except RuntimeError as exc:  # closed during shutdown
+                self._fail(rsp, trace, "shed", 503, str(exc))
+                return
+        PATHS.inc(path=path)
+        trace.note(path=path, path_reason=reason)
+        ctx = _InFlight(
+            self, trace, rsp, future, path=path, deadline_s=deadline_s,
+            row=row[0] if path == "host" else None,
+        )
         # Deadline on the loop clock; the done-callback and the timer race
         # under the ctx lock, so exactly one replies. add_done_callback
         # runs inline when the future already resolved.
         ctx.timer = self.handle.httpd.call_later(
-            self.request_timeout_s, ctx.on_deadline
+            deadline_s, ctx.on_deadline
         )
         future.add_done_callback(ctx.on_done)
 
@@ -677,11 +857,40 @@ def make_server(
     max_connections: int = 8192,
     reuse_port: bool = False,
     worker_id: int | None = None,
+    host_path: bool = False,
+    host_buckets=DEFAULT_HOST_BUCKETS,
+    host_workers: int = 1,
+    burst_depth: int = 1,
+    tight_deadline_s: float = 0.05,
+    quality_async: bool = True,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
-    ``start_background``). ``max_batch_size`` defaults to the largest
-    bucket so a full batch pads nothing.
+    ``start_background``). ``max_batch_size`` defaults to
+    ``CPU_DEFAULT_MAX_BATCH`` (64) on the CPU backend — BENCH.md's
+    measured recommendation; big flushes there are pure padded waste —
+    and to the largest bucket on device backends, where a full top
+    bucket pads nothing.
+
+    Dual-path scoring (docs/SERVING.md "Dual-path scoring"): with
+    ``host_path=True`` (the ``cli serve`` default; off here so embedded
+    and test callers opt in) a ``HostScorer`` — the SAME engine
+    composition pre-traced on the host CPU backend at ``host_buckets`` —
+    answers requests the ``PathRouter`` routes away from the batcher:
+    singles and small groups on an idle server skip both the coalescing
+    window and the accelerator round trip, at bit-for-bit parity with
+    the device path. ``host_workers`` bounds the pool (a busy host path
+    self-routes back to the device); ``burst_depth`` is the batcher
+    queue depth at which coalescing wins; requests whose effective
+    deadline is at or under ``tight_deadline_s`` prefer the host path.
+    The split is exported as ``serve_path_total{path=…}``, echoed
+    per-reply as ``X-Serve-Path``, and annotated on every trace.
+
+    ``quality_async`` (default) feeds the drift monitor through
+    ``obs.quality.AsyncQualityFeed`` — a bounded hand-off serviced by a
+    background thread, sampling then shedding (counted) under pressure —
+    instead of running binning and PSI refreshes on the flush thread
+    (measured at ~30% of saturated throughput in r11).
 
     Request-scoped observability: ``recorder`` (default a fresh
     ``reqtrace.FlightRecorder(trace_capacity, tail_quantile)``) receives
@@ -787,10 +996,17 @@ def make_server(
                 window=quality_window,
                 feature_names=feature_names,
             )
+    # The engine (and the host scorer) feed rows through the async
+    # hand-off by default: drift math must not tax the flush thread.
+    quality_feed = None
+    engine_quality = quality_monitor
+    if quality_monitor is not None and quality_async:
+        quality_feed = qualitymod.AsyncQualityFeed(quality_monitor)
+        engine_quality = quality_feed
     if fault_endpoint:
         faults.enable_endpoint()
     engine = BucketedPredictEngine(
-        params, buckets=buckets, quality=quality_monitor
+        params, buckets=buckets, quality=engine_quality
     )
     if supervise:
         engine_buckets = engine.buckets
@@ -801,7 +1017,7 @@ def make_server(
             # made the first post-recovery requests pay the compile bill
             # would turn recovery into a tail-latency incident.
             eng = BucketedPredictEngine(
-                params, buckets=engine_buckets, quality=quality_monitor
+                params, buckets=engine_buckets, quality=engine_quality
             )
             eng.warmup(say=say)
             return eng
@@ -813,14 +1029,33 @@ def make_server(
             restart_backoff_s=restart_backoff_s,
             restart_backoff_max_s=restart_backoff_max_s,
         )
+    if max_batch_size is None:
+        import jax
+
+        # BENCH.md's CPU recommendation is the default there; device
+        # backends keep the full top bucket.
+        max_batch_size = (
+            min(CPU_DEFAULT_MAX_BATCH, engine.buckets[-1])
+            if jax.default_backend() == "cpu" else engine.buckets[-1]
+        )
     metrics = ServingMetrics(batch_buckets=engine.buckets)
     batcher = MicroBatcher(
         engine,
-        max_batch_size=max_batch_size or engine.buckets[-1],
+        max_batch_size=max_batch_size,
         max_wait_ms=max_wait_ms,
         max_queue=max_queue,
         metrics=metrics,
     )
+    host_pool = router = None
+    if host_path:
+        scorer = HostScorer(
+            params, buckets=host_buckets, quality=engine_quality
+        )
+        host_pool = HostPath(scorer, workers=host_workers, metrics=metrics)
+        router = PathRouter(
+            batcher, host_pool,
+            burst_depth=burst_depth, tight_deadline_s=tight_deadline_s,
+        )
     if recorder is None:
         recorder = reqtrace.FlightRecorder(
             capacity=trace_capacity, tail_quantile=tail_quantile
@@ -845,6 +1080,7 @@ def make_server(
         engine, batcher, metrics, None,
         recorder=recorder, slo_tracker=slo_tracker, profile_dir=profile_dir,
         quality=quality_monitor, worker_id=worker_id,
+        host=host_pool, router=router, quality_feed=quality_feed,
     )
     app = _App(handle, request_timeout_s, quiet)
     try:
@@ -856,8 +1092,18 @@ def make_server(
         )
         if warmup:
             engine.warmup(say=say)
+            if host_pool is not None:
+                # The fast path's tiny ladder compiles in a fraction of
+                # the device warmup; until it is warm the router keeps
+                # every request on the device path (with --no-warmup the
+                # host path stays parked the same way).
+                host_pool.scorer.warmup(say=say)
     except BaseException:
         batcher.close(drain=False, timeout=1.0)
+        if host_pool is not None:
+            host_pool.close(timeout=1.0)
+        if quality_feed is not None:
+            quality_feed.close(timeout=1.0)
         close_engine = getattr(engine, "close", None)
         if close_engine is not None:
             close_engine()

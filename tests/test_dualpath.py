@@ -1,0 +1,613 @@
+"""Adaptive dual-path scoring (ISSUE 7): host-vs-device bit parity,
+routing policy, padding-aware batch shaping, and the async quality feed.
+
+The acceptance contract: the host fast path shares the device path's math
+(same ``impute_select``, same stacked blend) and answers singles
+bit-for-bit identically to the device path's single-row program; routing
+is a deterministic function of queue depth, in-flight flush state, host
+saturation, and request deadline; a flush splits into best-fit ladder
+sub-batches with no row lost, duplicated, or reordered and no new
+compiles; the quality feed runs off the hot path with every sampled or
+shed row counted.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.data.examples import (
+    EXAMPLE_PATIENT,
+    patient_row,
+)
+from machine_learning_replications_tpu.serve import (
+    BucketedPredictEngine,
+    HostBusy,
+    HostPath,
+    HostScorer,
+    MicroBatcher,
+    PathRouter,
+    make_server,
+)
+
+
+@pytest.fixture(scope="module")
+def stacking_params():
+    from sklearn.ensemble import (
+        GradientBoostingClassifier,
+        StackingClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    from machine_learning_replications_tpu.persist import import_stacking
+
+    rng = np.random.default_rng(11)
+    n, f = 250, 17
+    X = rng.normal(size=(n, f))
+    X[:, :10] = (X[:, :10] > 0.3).astype(float)
+    y = (X @ rng.normal(size=f) + rng.normal(size=n) > 0.1).astype(float)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = StackingClassifier(
+            estimators=[
+                ("svc", make_pipeline(
+                    StandardScaler(),
+                    SVC(probability=True, random_state=2020),
+                )),
+                ("gbc", GradientBoostingClassifier(
+                    n_estimators=10, max_depth=1, random_state=2020)),
+                ("lg", LogisticRegression()),
+            ],
+            final_estimator=LogisticRegression(),
+        ).fit(X, y)
+    return import_stacking(clf)
+
+
+@pytest.fixture(scope="module")
+def query_rows():
+    rng = np.random.default_rng(29)
+    X = rng.normal(size=(80, 17))
+    X[:, :10] = (X[:, :10] > 0.3).astype(float)
+    return X
+
+
+# ---------------------------------------------------------------------------
+# host scorer: shared math, bit-for-bit single-row parity
+# ---------------------------------------------------------------------------
+
+
+def test_host_scorer_single_row_parity_bitwise(stacking_params, query_rows):
+    """The parity contract the router relies on: for the workload the
+    host path serves (single rows), host and device run the same-shape
+    program of the same shared composition — results are bit-identical
+    across the whole contract row space."""
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8))
+    eng.warmup()
+    host = HostScorer(stacking_params, buckets=(1, 8))
+    host.warmup()
+    for i in range(query_rows.shape[0]):
+        h = host.predict(query_rows[i:i + 1])
+        d = eng.predict(query_rows[i:i + 1])
+        np.testing.assert_array_equal(h, d)
+    # small groups share the 8-bucket program: bit-identical too
+    np.testing.assert_array_equal(
+        host.predict(query_rows[:5]), eng.predict(query_rows[:5])
+    )
+
+
+def test_host_scorer_warmup_pretraces(stacking_params):
+    host = HostScorer(stacking_params, buckets=(1, 8))
+    assert not host.warm
+    host.warmup()
+    assert host.warm
+    assert host.trace_counts == {1: 1, 8: 1}
+    host.predict(patient_row())
+    assert host.trace_counts == {1: 1, 8: 1}  # pre-traced: no new compile
+
+
+# ---------------------------------------------------------------------------
+# routing policy: every branch forced
+# ---------------------------------------------------------------------------
+
+
+class _FakeBatcher:
+    def __init__(self, depth=0, flushing=False):
+        self.queue_depth = depth
+        self.flush_in_progress = flushing
+
+
+class _FakeHost:
+    def __init__(self, saturated=False, available=True):
+        self.saturated = saturated
+        self.available = available
+
+
+def test_router_decisions_under_forced_state():
+    r = PathRouter(_FakeBatcher(), _FakeHost(), burst_depth=2,
+                   tight_deadline_s=0.05)
+    assert r.decide() == ("host", "idle")
+    # queued rows at/above the burst depth coalesce on the device
+    r.batcher = _FakeBatcher(depth=2)
+    assert r.decide() == ("device", "coalescing")
+    r.batcher = _FakeBatcher(depth=5)
+    assert r.decide(deadline_s=30.0) == ("device", "coalescing")
+    # a tight deadline overrides coalescing — it cannot afford the wait
+    assert r.decide(deadline_s=0.05) == ("host", "tight_deadline")
+    # a flush mid-compute with an empty queue: host avoids serializing
+    # behind the running flush
+    r.batcher = _FakeBatcher(depth=0, flushing=True)
+    assert r.decide() == ("host", "flush_in_progress")
+    # saturation and unavailability always fall back to the device
+    r.host = _FakeHost(saturated=True)
+    assert r.decide(deadline_s=0.01) == ("device", "host_saturated")
+    r.host = _FakeHost(available=False)
+    assert r.decide() == ("device", "host_unavailable")
+    r.host = None
+    assert r.decide() == ("device", "no_host_path")
+    with pytest.raises(ValueError):
+        PathRouter(_FakeBatcher(), _FakeHost(), burst_depth=0)
+
+
+def test_host_path_pool_saturation_and_close(stacking_params):
+    """HostBusy the instant every slot is taken; slots free as work
+    completes; close fails pending work fast."""
+
+    class _SlowScorer:
+        warm = True
+
+        def __init__(self):
+            self.release = threading.Event()
+
+        def predict(self, X):
+            self.release.wait(5.0)
+            return X.mean(axis=1)
+
+    scorer = _SlowScorer()
+    pool = HostPath(scorer, workers=1)
+    try:
+        f1 = pool.submit(np.full(17, 2.0))
+        time.sleep(0.05)  # the worker claims f1 and blocks
+        with pytest.raises(HostBusy):
+            pool.submit(np.full(17, 3.0))
+        assert pool.saturated
+        scorer.release.set()
+        assert f1.result(timeout=5.0) == 2.0
+        for _ in range(100):
+            if not pool.saturated:
+                break
+            time.sleep(0.01)
+        assert not pool.saturated
+    finally:
+        pool.close()
+    with pytest.raises(RuntimeError):
+        pool.submit(np.full(17, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# batch shaping: split correctness + compile bound
+# ---------------------------------------------------------------------------
+
+
+def test_plan_batch_shapes(stacking_params):
+    eng = BucketedPredictEngine(
+        stacking_params, buckets=(1, 8, 32, 64, 128, 256, 512)
+    )
+    # singles and exact buckets: one chunk, zero pad
+    for n in (1, 8, 32, 64, 128, 256, 512):
+        assert eng.plan_batch(n) == (n,)
+    # the r11 waste cases: 65 → 64+1 (was: pad 447 rows into 512),
+    # 200 → 128+64+8 exact (was: pad 312)
+    assert eng.plan_batch(65) == (64, 1)
+    assert eng.plan_batch(200) == (128, 64, 8)
+    # splitting never wins when the padding saved is under the dispatch
+    # penalty: tiny batches keep one padded bucket
+    assert eng.plan_batch(2) == (8,)
+    assert eng.plan_batch(7) == (8,)
+    # oversize: whole top-bucket chunks then the shaped remainder
+    assert eng.plan_batch(512 + 65) == (512, 64, 1)
+    assert eng.plan_batch(0) == ()
+    # every chunk is a ladder bucket and the plan covers exactly once
+    for n in range(1, 600, 7):
+        plan = eng.plan_batch(n)
+        assert all(b in eng.buckets for b in plan)
+        assert sum(plan) >= n > sum(plan[:-1])
+    with pytest.raises(ValueError):
+        BucketedPredictEngine(stacking_params, buckets=(1, 8), max_split=0)
+
+
+def test_split_flush_order_no_loss_no_dup_compile_bound(
+    stacking_params, query_rows
+):
+    """A split flush returns row i's probability at position i (order
+    preserved, nothing lost or duplicated — distinct rows prove it), and
+    runs only warmed ladder programs (zero new compiles)."""
+    from machine_learning_replications_tpu.models import stacking
+
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8, 64))
+    eng.warmup()
+    compiled = dict(eng.trace_counts)
+    direct = np.asarray(stacking.predict_proba1(stacking_params, query_rows))
+    for n in (9, 10, 65, 73, 80):
+        plan = eng.plan_batch(n)
+        got = eng.predict(query_rows[:n])
+        assert got.shape == (n,)
+        # order + identity: every row's answer equals its own direct
+        # score (distinct rows → a swap/dup/drop cannot cancel out)
+        np.testing.assert_allclose(
+            got, direct[:n], rtol=1e-12, atol=1e-15
+        )
+        assert len(set(direct[:n])) == n  # the oracle really is distinct
+        assert sum(plan) >= n
+    assert eng.trace_counts == compiled  # per-sub-batch compile bound
+
+
+def test_batcher_accounts_shaped_padding(stacking_params):
+    """The flush's padding metric is the PLAN's pad count, not the old
+    single-covering-bucket count."""
+    from machine_learning_replications_tpu.serve import ServingMetrics
+
+    eng = BucketedPredictEngine(stacking_params, buckets=(1, 8, 64))
+    eng.warmup()
+    m = ServingMetrics()
+    b = MicroBatcher(eng, max_batch_size=9, max_wait_ms=10_000,
+                     max_queue=64, metrics=m)
+    try:
+        futs = [b.submit(patient_row()[0]) for _ in range(9)]
+        for f in futs:
+            f.result(timeout=10.0)
+        snap = m.padding_waste.snapshot()
+        # 9 rows ran as (8, 1): zero pad rows, where the covering 64
+        # bucket would have recorded 55
+        assert snap["count"] == 1 and snap["sum"] == 0.0
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# async quality feed: off-hot-path observation + drop accounting
+# ---------------------------------------------------------------------------
+
+
+def _tiny_profile(rng, n=64):
+    from machine_learning_replications_tpu.obs import quality
+
+    X = rng.normal(size=(n, 3))
+    return quality.build_reference_profile(X, rng.uniform(size=n))
+
+
+def test_async_feed_delivers_and_drains():
+    from machine_learning_replications_tpu.obs import quality
+    from machine_learning_replications_tpu.obs.registry import (
+        MetricsRegistry,
+    )
+
+    rng = np.random.default_rng(3)
+    mon = quality.QualityMonitor(
+        _tiny_profile(rng), registry=MetricsRegistry(), min_rows=10,
+        window=128,
+    )
+    feed = quality.AsyncQualityFeed(mon)
+    try:
+        for _ in range(4):
+            feed.observe_batch(
+                rng.normal(size=(20, 3)), rng.uniform(size=20)
+            )
+        assert feed.drain(timeout=5.0)
+        stats = feed.stats()
+        assert stats["observed_rows"] == 80
+        assert stats["dropped_rows"] == 0 and stats["sampled_out_rows"] == 0
+        assert mon.snapshot()["rows_total"] == 80
+    finally:
+        feed.close()
+
+
+def test_async_feed_sampling_then_shedding_counted():
+    """Backpressure accounting: at half capacity incoming batches are
+    stride-sampled; at full capacity they shed whole — and the sum of
+    observed + sampled_out + dropped equals every row ever offered."""
+    from machine_learning_replications_tpu.obs import quality
+    from machine_learning_replications_tpu.obs.registry import (
+        MetricsRegistry,
+    )
+
+    rng = np.random.default_rng(5)
+    mon = quality.QualityMonitor(
+        _tiny_profile(rng), registry=MetricsRegistry(), min_rows=10,
+        window=128,
+    )
+
+    gate = threading.Event()
+    orig = mon.observe_batch
+
+    def slow_observe(X, p1, members=None):
+        gate.wait(10.0)
+        return orig(X, p1, members)
+
+    mon.observe_batch = slow_observe
+    feed = quality.AsyncQualityFeed(mon, capacity=4, sample_stride=2)
+    offered = 0
+    try:
+        # worker blocks on the first batch; queue then holds up to 4
+        for _ in range(8):
+            feed.observe_batch(
+                rng.normal(size=(10, 3)), rng.uniform(size=10)
+            )
+            offered += 10
+        stats = feed.stats()
+        assert stats["sampled_out_rows"] > 0   # half-full → stride sampling
+        assert stats["dropped_rows"] > 0       # full → whole-batch shed
+        gate.set()
+        assert feed.drain(timeout=10.0)
+        stats = feed.stats()
+        assert (
+            stats["observed_rows"] + stats["sampled_out_rows"]
+            + stats["dropped_rows"] == offered
+        )
+        assert mon.snapshot()["rows_total"] == stats["observed_rows"]
+    finally:
+        gate.set()
+        feed.close()
+
+
+def test_async_feed_quarantines_failing_monitor(tmp_path):
+    """A monitor raising on the feed thread quarantines exactly like the
+    old in-engine feed: one journaled event, monitor.disable on every
+    surface, feed dead (drops counted) until reenable."""
+    from machine_learning_replications_tpu.obs import journal, quality
+    from machine_learning_replications_tpu.obs.registry import (
+        MetricsRegistry,
+    )
+
+    rng = np.random.default_rng(7)
+    mon = quality.QualityMonitor(
+        _tiny_profile(rng), registry=MetricsRegistry(), min_rows=10,
+        window=128,
+    )
+    jrn = journal.RunJournal(tmp_path / "feed.jsonl", command="serve")
+    journal.set_journal(jrn)
+    feed = quality.AsyncQualityFeed(mon)
+    try:
+        bad = rng.normal(size=(5, 3))
+        bad[0, 0] = np.nan  # observe_batch raises on non-finite rows
+        feed.observe_batch(bad, rng.uniform(size=5))
+        feed.drain(timeout=5.0)
+        assert feed.stats()["dead"]
+        assert mon.health()["status"] == "disabled"
+        # the poison batch's own rows count as dropped (reason=dead) —
+        # they never reached the window
+        assert feed.stats()["dropped_rows"] == 5
+        # dead feed: subsequent rows are counted as drops, not lost silently
+        feed.observe_batch(rng.normal(size=(5, 3)), rng.uniform(size=5))
+        feed.drain(timeout=5.0)
+        stats = feed.stats()
+        assert stats["dropped_rows"] == 10
+        # the offered = observed + sampled_out + dropped identity holds
+        # through a quarantine
+        assert stats["observed_rows"] + stats["sampled_out_rows"] \
+            + stats["dropped_rows"] == 10
+        # supervisor contract: reenable clears the quarantine
+        assert feed.reenable()
+        assert mon.health()["status"] != "disabled"
+        feed.observe_batch(rng.normal(size=(8, 3)), rng.uniform(size=8))
+        assert feed.drain(timeout=5.0)
+        assert feed.stats()["observed_rows"] == 8
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+        feed.close()
+    events = [json.loads(line) for line in open(tmp_path / "feed.jsonl")]
+    disabled = [e for e in events if e.get("kind") == "quality_feed_disabled"]
+    assert len(disabled) == 1 and "finite" in disabled[0]["error"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over HTTP: routing live, parity per path, metrics split
+# ---------------------------------------------------------------------------
+
+
+def _post(url, obj, headers=None, timeout=30.0):
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(
+        url + "/predict", data=json.dumps(obj).encode(), headers=h
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.headers, json.loads(resp.read())
+
+
+def _path_counts(url):
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        page = r.read().decode()
+    out = {}
+    for line in page.splitlines():
+        if line.startswith("serve_path_total{"):
+            label, value = line.rsplit(" ", 1)
+            out[label.split('"')[1]] = float(value)
+    return out
+
+
+@pytest.fixture()
+def routed(stacking_params):
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), max_wait_ms=2.0,
+        max_queue=64, host_path=True,
+    ).start_background()
+    host, port = handle.address
+    yield handle, f"http://{host}:{port}"
+    handle.shutdown()
+
+
+def test_http_single_routes_host_with_bit_parity(routed, stacking_params):
+    from machine_learning_replications_tpu.models import stacking
+
+    handle, url = routed
+    direct = float(stacking.predict_proba1(stacking_params, patient_row())[0])
+    status, headers, body = _post(url, dict(EXAMPLE_PATIENT))
+    assert status == 200
+    assert headers.get("X-Serve-Path") == "host"
+    assert body["probability"] == direct  # bit-for-bit vs the CLI route
+    counts = _path_counts(url)
+    assert counts["host"] >= 1
+    # the trace carries the path annotation + host_compute phase
+    with urllib.request.urlopen(url + "/debug/requests?n=8",
+                                timeout=30) as r:
+        dbg = json.loads(r.read())
+    tr = next(t for t in dbg["requests"] if t.get("path") == "host")
+    assert "host_compute" in tr["phases"]
+    assert "device_compute" not in tr["phases"]
+    assert tr["path_reason"] in ("idle", "flush_in_progress")
+
+
+def test_http_burst_routes_device(routed):
+    """Concurrent burst: the admission queue fills, the router coalesces
+    into device micro-batches — both paths end up serving traffic."""
+    handle, url = routed
+    before = _path_counts(url)
+    n_threads = 24
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def one():
+        try:
+            barrier.wait(10.0)
+            for _ in range(4):
+                status, _, _ = _post(url, dict(EXAMPLE_PATIENT))
+                assert status == 200
+        except Exception as exc:  # pragma: no cover - diagnostic
+            errors.append(exc)
+
+    threads = [threading.Thread(target=one) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    after = _path_counts(url)
+    assert after["device"] > before.get("device", 0)  # bursts coalesced
+    assert sum(after.values()) - sum(before.values()) == n_threads * 4
+
+
+def test_http_tight_deadline_header_routes_host(routed):
+    handle, url = routed
+    status, headers, _ = _post(
+        url, dict(EXAMPLE_PATIENT),
+        headers={"X-Request-Deadline-Ms": "40"},
+    )
+    assert status == 200
+    assert headers.get("X-Serve-Path") == "host"
+
+
+def test_host_failure_falls_back_transparently(routed):
+    """A one-shot host-path compute fault: the client still gets a
+    correct 200 (served by the device fallback), the request counts ONCE
+    in serve_requests_total, and the published trace's phases still
+    partition the request (the failed attempt's stamps are dropped)."""
+    from machine_learning_replications_tpu.resilience import faults
+
+    handle, url = routed
+    status, headers, golden_body = _post(url, dict(EXAMPLE_PATIENT))
+    assert status == 200
+
+    def requests_total():
+        with urllib.request.urlopen(url + "/metrics?format=json",
+                                    timeout=30) as r:
+            return json.loads(r.read())["requests_total"]
+
+    before = requests_total()
+    faults.arm("engine.compute:raise@count=1")
+    try:
+        status, headers, body = _post(url, dict(EXAMPLE_PATIENT))
+    finally:
+        faults.reset()
+    assert status == 200
+    assert body["probability"] == golden_body["probability"]
+    assert headers.get("X-Serve-Path") == "device"  # the fallback served
+    assert requests_total() == before + 1  # one logical request, once
+    with urllib.request.urlopen(url + "/debug/requests?n=16",
+                                timeout=30) as r:
+        dbg = json.loads(r.read())
+    tr = next(
+        t for t in dbg["requests"]
+        if t.get("path_reason") == "host_error_fallback"
+    )
+    assert tr["path"] == "device"
+    assert "host_compute" not in tr["phases"]  # failed attempt dropped
+    total = tr["total_seconds"]
+    assert sum(p["seconds"] for p in tr["phases"].values()) <= total + 1e-6
+
+
+def test_no_host_path_by_default_in_make_server(stacking_params):
+    handle = make_server(
+        stacking_params, port=0, buckets=(1,), warmup=False,
+    ).start_background()
+    try:
+        host, port = handle.address
+        url = f"http://{host}:{port}"
+        assert handle.host is None and handle.router is None
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["host_path"] is False
+    finally:
+        handle.shutdown()
+
+
+def test_cpu_default_max_batch(stacking_params):
+    """Satellite: --max-batch defaults to 64 on the CPU backend (capped
+    by the ladder top), keeping saturated flushes in the cheap
+    executable; an explicit value still wins."""
+    import jax
+
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8, 128), warmup=False,
+    )
+    try:
+        expected = 64 if jax.default_backend() == "cpu" else 128
+        assert handle.batcher._max_batch == expected
+    finally:
+        handle.shutdown()
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8), warmup=False,
+    )
+    try:
+        assert handle.batcher._max_batch == 8  # capped at the ladder top
+    finally:
+        handle.shutdown()
+    handle = make_server(
+        stacking_params, port=0, buckets=(1, 8, 128),
+        max_batch_size=100, warmup=False,
+    )
+    try:
+        assert handle.batcher._max_batch == 100
+    finally:
+        handle.shutdown()
+
+
+def test_loadgen_artifact_paths_block(routed, tmp_path):
+    """Satellite: the loadgen artifact's ``paths`` block records the
+    routing split from the echoed X-Serve-Path header."""
+    import subprocess
+    import sys
+
+    handle, url = routed
+    out = tmp_path / "paths.json"
+    proc = subprocess.run(
+        [sys.executable, "tools/loadgen.py", "--url", url,
+         "--mode", "closed", "--concurrency", "2", "--duration", "2",
+         "--out", str(out)],
+        capture_output=True, text=True, cwd=".",
+    )
+    assert proc.returncode == 0, proc.stderr
+    art = json.loads(out.read_text())
+    assert art["paths"] is not None
+    assert art["paths"]["source"] == "reply_header"
+    counts = art["paths"]["counts"]
+    assert sum(counts.values()) == art["n_ok"] > 0
+    assert set(counts) <= {"host", "device"}
+    for path_name in counts:
+        assert art["paths"]["latency_ms"][path_name]["p50"] is not None

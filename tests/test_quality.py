@@ -182,6 +182,10 @@ def _stable_monitor(n_ref=4000, window=1024, **kw):
     X = rng.normal(size=(n_ref, 17))
     scores = 1.0 / (1.0 + np.exp(-X @ rng.normal(size=17) / 4.0))
     prof = quality.build_reference_profile(X, scores, (scores > 0.5).astype(float))
+    # Zero time floor so back-to-back observes refresh synchronously —
+    # these tests exercise the statistics; the production 1 s throttle
+    # has its own test below.
+    kw.setdefault("refresh_interval_s", 0.0)
     mon = quality.QualityMonitor(
         prof, window=window, registry=MetricsRegistry(), **kw
     )
@@ -351,6 +355,46 @@ def test_quality_families_are_exposition_valid_before_and_after_traffic():
     for line in page.splitlines():
         if line.startswith("quality_score_psi "):
             assert float(line.split()[-1]) < quality.DEFAULT_WARN_PSI
+
+
+def test_vectorized_refresh_matches_scalar_oracle():
+    """The refresh path's row-wise PSI/KS (one flat bincount + 2D math —
+    the r12 hot-path rewrite) must agree with the scalar spec functions
+    to float precision on every feature."""
+    mon, X, scores, rng = _stable_monitor(window=512, min_rows=50)
+    mon.observe_batch(rng.normal(size=(512, 17)) * 1.3 + 0.2,
+                      rng.choice(scores, size=512))
+    snap = mon.snapshot(detail=True)
+    ref = mon._profile["bin_counts"]
+    for f in range(17):
+        counts = np.bincount(mon._feat_ring[:512, f], minlength=10)
+        expect_psi = quality.psi(ref[f], counts)
+        expect_ks = quality.ks_binned(ref[f], counts)
+        got = next(
+            d for d in snap["features"]
+            if d["name"] == mon.feature_names[f]
+        )
+        assert got["psi"] == pytest.approx(expect_psi, abs=1e-6)
+        assert got["ks"] == pytest.approx(expect_ks, abs=1e-6)
+
+
+def test_refresh_interval_throttles_observe_but_not_snapshot():
+    """The r12 saturated-flush-loop guard: back-to-back observes inside
+    the time floor skip the PSI pass (the status lags), but snapshot()
+    always forces a fresh computation."""
+    mon, X, scores, rng = _stable_monitor(
+        window=512, min_rows=100, refresh_interval_s=3600.0
+    )
+    mon.observe_batch(X[:512], rng.choice(scores, size=512))
+    assert mon.status == "ok"  # first refresh fires (never refreshed yet)
+    shifted = X[:512].copy()
+    shifted[:, 0] += 5.0
+    mon.observe_batch(shifted, rng.choice(scores, size=512))
+    # inside the floor: observe did NOT recompute...
+    assert mon.status == "ok"
+    # ...but an explicit snapshot always does (and journals transitions)
+    assert mon.snapshot()["status"] == "alert"
+    assert mon.status == "alert"
 
 
 def test_status_gauge_and_transition_counter_track_status():

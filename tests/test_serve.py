@@ -116,13 +116,26 @@ def test_engine_parity_and_padding_neutrality(stacking_params, query_rows):
         got = eng.predict(query_rows[:n])
         assert got.shape == (n,)
         np.testing.assert_allclose(got, direct[:n], rtol=1e-12, atol=1e-15)
-    # bit-for-bit padding neutrality within each bucket: 2 and 7 rows both
-    # pad into the 8-bucket; 9 and 63 both into the 64-bucket
+    # bit-for-bit padding neutrality within a shared batch plan: 2 and 7
+    # rows both run the padded (8,) plan; 40 and 63 both the padded (64,)
+    assert eng.plan_batch(2) == eng.plan_batch(7) == (8,)
+    assert eng.plan_batch(40) == eng.plan_batch(63) == (64,)
     np.testing.assert_array_equal(
         eng.predict(query_rows[:7])[:2], eng.predict(query_rows[:2])
     )
     np.testing.assert_array_equal(
-        eng.predict(query_rows[:63])[:9], eng.predict(query_rows[:9])
+        eng.predict(query_rows[:63])[:40], eng.predict(query_rows[:40])
+    )
+    # batch shaping: 9 rows split into a full 8-chunk plus a 1-chunk
+    # (zero pad rows) instead of padding 55 rows into the 64 bucket —
+    # and the split is exactly those two programs on those rows, so the
+    # shaped result is bit-identical to running the chunks by hand
+    assert eng.plan_batch(9) == (8, 1)
+    np.testing.assert_array_equal(
+        eng.predict(query_rows[:9]),
+        np.concatenate([
+            eng.predict(query_rows[:8]), eng.predict(query_rows[8:9]),
+        ]),
     )
 
 
@@ -659,6 +672,25 @@ def test_pipeline_engine_matches_cli_route(pipeline_params, query_rows):
     )
     # compile bound holds on the pipeline route too
     assert eng.trace_counts == {1: 1, 8: 1}
+
+    # dual-path parity on the NaN-imputed route: the host fast path runs
+    # the SAME contract_rows_to_x64 → impute_select → stacked-blend
+    # composition (non-schema columns NaN, KNN-imputed), bit-for-bit
+    # identical to the device path's same-shape program for singles and
+    # shared-bucket groups
+    from machine_learning_replications_tpu.serve import HostScorer
+
+    host = HostScorer(pipeline_params, buckets=(1, 8))
+    host.warmup()
+    np.testing.assert_array_equal(host.predict(x), eng.predict(x))
+    for i in range(4):
+        np.testing.assert_array_equal(
+            host.predict(query_rows[i:i + 1]),
+            eng.predict(query_rows[i:i + 1]),
+        )
+    np.testing.assert_array_equal(
+        host.predict(query_rows[:5]), eng.predict(query_rows[:5])
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,12 @@ Scenarios:
   edge_faults       ``server.parse:raise`` (explicit 500, body unread)
                     and ``server.respond:raise`` (connection dropped with
                     nothing written — never a partial 200).
+  dual_path_routing the drill runs with the dual-path router enabled
+                    (the ``cli serve`` default): both scoring paths
+                    serve the golden bits, and a one-shot host-path
+                    fault is absorbed by the transparent device
+                    fallback — the client sees a correct 200, the
+                    fallback counter moves.
   corrupt_restore   offline: a corrupted checkpoint rolls back to the
                     retained last-known-good (journaled), and the
                     rolled-back params serve the previous model's exact
@@ -84,12 +90,18 @@ class Outcomes:
 
 
 def post_predict(base: str, patient: dict, golden: float | None,
-                 out: Outcomes) -> tuple[str, dict]:
-    """One /predict request, classified. Returns (kind, info)."""
+                 out: Outcomes, pin: str | None = None) -> tuple[str, dict]:
+    """One /predict request, classified. Returns (kind, info). ``pin``
+    routes the request to a specific scoring path (X-Serve-Path) —
+    scenarios asserting supervised-engine semantics (watchdog, flush
+    faults) pin ``device`` so the probe exercises the batcher even when
+    the dual-path router would answer it from the host."""
     body = json.dumps(patient).encode()
+    headers = {"Content-Type": "application/json"}
+    if pin:
+        headers["X-Serve-Path"] = pin
     req = urllib.request.Request(
-        base + "/predict", data=body,
-        headers={"Content-Type": "application/json"},
+        base + "/predict", data=body, headers=headers,
     )
     t0 = time.monotonic()
     try:
@@ -206,6 +218,11 @@ def main(argv=None) -> int:
         supervise=True, flush_deadline_s=0.6, breaker_failures=2,
         restart_backoff_s=0.25, restart_backoff_max_s=2.0,
         fault_endpoint=True,
+        # Routing ON for the whole drill (the cli serve default): the
+        # degradation contract must hold with the dual-path router in
+        # the loop — host-path failures fall back through the supervised
+        # device path, so the breaker arc below is unchanged.
+        host_path=True,
     ).start_background()
     host, port = handle.address
     base = f"http://{host}:{port}"
@@ -292,9 +309,12 @@ def main(argv=None) -> int:
         }
 
         # --- scenario: wedged_compute -------------------------------------
+        # Pinned to the device path: the watchdog under test lives in the
+        # supervised engine (an unpinned single would route host, where
+        # the 2 s stall is just a slow-but-bounded correct answer).
         out = Outcomes()
         post_faults(base, {"arm": "engine.compute:delay=2.0@n=1"})
-        kind, info = post_predict(base, patient, golden, out)
+        kind, info = post_predict(base, patient, golden, out, pin="device")
         # The wedge is detected at the 0.6 s flush deadline: the client
         # gets an explicit 504 (or a 503 if a concurrent probe opened the
         # breaker first) in bounded time — never the 2 s injected stall.
@@ -306,11 +326,30 @@ def main(argv=None) -> int:
         out = Outcomes()
         post_faults(base, {"arm": "batcher.flush:delay=0.8@n=1"})
         t0 = time.monotonic()
-        kind, _ = post_predict(base, patient, golden, out)
+        kind, _ = post_predict(base, patient, golden, out, pin="device")
         dt = time.monotonic() - t0
         assert kind == "ok" and dt >= 0.8, (kind, dt)
         scenarios["flush_delay"] = {**out.as_dict(),
                                     "delayed_seconds": round(dt, 3)}
+
+        # --- scenario: dual_path_routing ----------------------------------
+        # Routing itself under chaos: both paths serve the golden bits,
+        # and a one-shot host-path fault is absorbed by the transparent
+        # device fallback (200, correct, client never sees it).
+        out = Outcomes()
+        for pin in ("host", "device", None):
+            kind, info = post_predict(base, patient, golden, out, pin=pin)
+            assert kind == "ok", (pin, kind, info)
+        post_faults(base, {"arm": "engine.compute:raise@count=1"})
+        kind, info = post_predict(base, patient, golden, out, pin="host")
+        assert kind == "ok", (kind, info)  # fallback answered correctly
+        _, m = get_json(base, "/metrics?format=json")
+        paths = m["runtime"].get("serve_path_total", {})
+        assert paths.get("path=host", 0) >= 1 and \
+            paths.get("path=device", 0) >= 1, paths
+        assert m["runtime"].get("serve_host_fallback_total", 0) >= 1, \
+            m["runtime"].get("serve_host_fallback_total")
+        scenarios["dual_path_routing"] = {**out.as_dict(), "paths": paths}
 
         # --- scenario: edge_faults ----------------------------------------
         out = Outcomes()

@@ -203,24 +203,54 @@ class _Tally:
         self.n_retries = 0   # 503 replies retried after backoff
         self.n_gaveup = 0    # logical requests still shed after max retries
         self.n_worst = n_worst
+        # Per-path ok counts from the echoed X-Serve-Path header (the
+        # dual-path router's split — "unknown" covers pre-dual-path
+        # servers that echo nothing).
+        self.paths: dict[str, int] = {}
+        # Per-path ok latencies, so the artifact can state the host-path
+        # p50 next to the device-path p50 in one run.
+        self.path_latency_ms: dict[str, list[float]] = {}
         # (latency_ms, request_id, status) for every id-carrying reply;
         # reduced to the n_worst slowest at artifact time. One tuple per
         # request is fine for bench durations (minutes, not days).
         self.ided: list[tuple[float, str, str]] = []
 
     def record(
-        self, status: str, latency_ms: float, request_id: str | None = None
+        self, status: str, latency_ms: float, request_id: str | None = None,
+        path: str | None = None,
     ) -> None:
         with self.lock:
             if status == "ok":
                 self.n_ok += 1
                 self.ok_latency_ms.append(latency_ms)
+                key = path or "unknown"
+                self.paths[key] = self.paths.get(key, 0) + 1
+                self.path_latency_ms.setdefault(key, []).append(latency_ms)
             elif status == "shed":
                 self.n_shed += 1
             else:
                 self.n_err += 1
             if request_id:
                 self.ided.append((latency_ms, request_id, status))
+
+    def paths_block(self) -> dict | None:
+        """The artifact's ``paths`` block: ok-reply counts and latency
+        quantiles per scoring path. None when no reply carried the
+        header (a pre-dual-path server)."""
+        with self.lock:
+            if set(self.paths) <= {"unknown"}:
+                return None
+            return {
+                "source": "reply_header",
+                "counts": dict(sorted(self.paths.items())),
+                "latency_ms": {
+                    k: {
+                        q: None if v is None else round(v, 3)
+                        for q, v in _percentiles(xs).items()
+                    }
+                    for k, xs in sorted(self.path_latency_ms.items())
+                },
+            }
 
     def worst_requests(self) -> list[dict]:
         """The slowest server-identified requests — the join keys against
@@ -357,8 +387,8 @@ class _KeepAliveClient:
         return resp
 
     def post_predict(self, body: bytes):
-        """(status, x_request_id, retry_after) — raises on transport
-        errors (after the one fresh-connection resend)."""
+        """(status, x_request_id, retry_after, serve_path) — raises on
+        transport errors (after the one fresh-connection resend)."""
         if self.conn is None:
             self._open()
             resp = self._once(body)
@@ -376,6 +406,7 @@ class _KeepAliveClient:
             resp.status,
             resp.getheader("X-Request-Id"),
             resp.getheader("Retry-After"),
+            resp.getheader("X-Serve-Path"),
         )
 
 
@@ -389,9 +420,9 @@ def _fire_keepalive(
     attempt = 0
     t0 = time.monotonic()
     while True:
-        rid = retry_after = None
+        rid = retry_after = path = None
         try:
-            code, rid, retry_after = client.post_predict(body)
+            code, rid, retry_after, path = client.post_predict(body)
             status = _classify(code)
         except Exception:
             status = "err"
@@ -404,7 +435,7 @@ def _fire_keepalive(
             time.sleep(sleep_s)
             attempt += 1
             continue
-        tally.record(status, latency_ms, rid)
+        tally.record(status, latency_ms, rid, path=path)
         return
 
 
@@ -551,7 +582,8 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
         c.requests_done += 1
         c.closed = True
 
-    def finish(c: _EvConn, status: str, rid, retry_after) -> None:
+    def finish(c: _EvConn, status: str, rid, retry_after,
+               path=None) -> None:
         """A reply (or terminal failure) for the logical request."""
         now = time.monotonic()
         latency_ms = (now - c.t0) * 1000.0
@@ -565,7 +597,7 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
             c.pending_new = False
             unregister(c)
             return
-        tally.record(status, latency_ms, rid)
+        tally.record(status, latency_ms, rid, path=path)
         c.requests_done += 1
         if now < stop:
             if interval and c.next_at > now:
@@ -632,6 +664,7 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
             finish(
                 c, status, headers.get("x-request-id"),
                 headers.get("retry-after"),
+                path=headers.get("x-serve-path"),
             )
         now = time.monotonic()
         for c in conns:
@@ -691,11 +724,12 @@ def _fire(
             url + "/predict", data=body,
             headers={"Content-Type": "application/json"},
         )
-        rid = retry_after = None
+        rid = retry_after = path = None
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 resp.read()
                 rid = resp.headers.get("X-Request-Id")
+                path = resp.headers.get("X-Serve-Path")
                 status = _classify(resp.status)
         except urllib.error.HTTPError as exc:
             exc.read()
@@ -718,7 +752,7 @@ def _fire(
             time.sleep(sleep_s)
             attempt += 1
             continue
-        tally.record(status, latency_ms, rid)
+        tally.record(status, latency_ms, rid, path=path)
         return
 
 
@@ -961,6 +995,10 @@ def main(argv=None) -> int:
             for k, v in _percentiles(tally.ok_latency_ms).items()
         },
         "worst_requests": tally.worst_requests(),
+        # Dual-path routing split (docs/SERVING.md): per-path ok counts
+        # and latency quantiles from the echoed X-Serve-Path header.
+        # Null against a server that predates the router.
+        "paths": tally.paths_block(),
         # Keep-alive reuse accounting (closed loop): opened_total near
         # n_connections means persistent connections really persisted;
         # reconnects counts idle-reap races absorbed by a fresh-socket

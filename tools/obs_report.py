@@ -278,7 +278,7 @@ def _phase_summary(trace: dict) -> str:
     phases = trace.get("phases") or {}
     parts = []
     for name in ("parse", "queue_wait", "batch_assembly",
-                 "device_compute", "respond"):
+                 "device_compute", "host_compute", "respond"):
         if name in phases:
             parts.append(f"{name} {_ms(phases[name].get('seconds'))}")
     extra = []

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Padded-vs-shaped flush cost bench — writes a SERVE_SHAPE_*.json artifact.
+
+Quantifies the engine's batch shaping (docs/SERVING.md "Batch shaping")
+directly, without HTTP noise: for each mid-size batch size it times
+``BucketedPredictEngine.predict`` under
+
+  padded   the r6–r11 coarse ladder (1/8/64/512) with splitting disabled
+           (``max_split=1``) — every batch pads into its covering bucket,
+           exactly the behavior BENCH.md r11 measured wasting up to 6×
+           the needed compute on 65–200-row flushes;
+  shaped   the ISSUE 7 default ladder (1/8/32/64/128/256/512) with
+           best-fit sub-batch decomposition (``plan_batch``).
+
+Both engines are fully warmed first, so every timed call is
+steady-state; each cell is the median of ``--repeats`` runs with the
+executed plan and pad-row counts recorded next to it. Run from the repo
+root::
+
+    JAX_PLATFORMS=cpu python tools/shape_bench.py \
+        --model /path/to/ckpt --out SERVE_SHAPE_r12_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+PADDED_LADDER = (1, 8, 64, 512)  # the pre-ISSUE-7 default
+SIZES = (16, 65, 100, 130, 200, 300, 512)
+
+
+def _time_predict(engine, X, repeats: int) -> float:
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        engine.predict(X)
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--model", help="Orbax checkpoint dir")
+    ap.add_argument("--pkl", help="legacy sklearn pickle")
+    ap.add_argument("--repeats", type=int, default=9)
+    ap.add_argument("--sizes", type=int, nargs="+", default=list(SIZES))
+    ap.add_argument("--out", default=None, help="artifact path (JSON)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from machine_learning_replications_tpu.data.examples import patient_row
+    from machine_learning_replications_tpu.persist import (
+        load_inference_params,
+    )
+    from machine_learning_replications_tpu.serve.engine import (
+        DEFAULT_BUCKETS,
+        BucketedPredictEngine,
+    )
+
+    params = load_inference_params(model=args.model, pkl=args.pkl)
+    padded = BucketedPredictEngine(
+        params, buckets=PADDED_LADDER, max_split=1
+    )
+    shaped = BucketedPredictEngine(params, buckets=DEFAULT_BUCKETS)
+    for eng, name in ((padded, "padded"), (shaped, "shaped")):
+        print(f"warming {name} ladder {eng.buckets} ...", file=sys.stderr)
+        eng.warmup()
+
+    row = patient_row()
+    rows = []
+    for n in args.sizes:
+        X = np.repeat(row, n, axis=0)
+        t_pad = _time_predict(padded, X, args.repeats)
+        t_shape = _time_predict(shaped, X, args.repeats)
+        cell = {
+            "rows": n,
+            "padded": {
+                "bucket": padded.bucket_for(n),
+                "pad_rows": padded.bucket_for(n) - n
+                if n <= padded.buckets[-1] else None,
+                "median_ms": round(t_pad * 1e3, 3),
+            },
+            "shaped": {
+                "plan": list(shaped.plan_batch(n)),
+                "pad_rows": sum(shaped.plan_batch(n)) - n,
+                "median_ms": round(t_shape * 1e3, 3),
+            },
+            "speedup": round(t_pad / t_shape, 2) if t_shape > 0 else None,
+        }
+        rows.append(cell)
+        print(
+            f"rows {n:4d}: padded {cell['padded']['median_ms']:8.2f} ms "
+            f"(bucket {cell['padded']['bucket']}) vs shaped "
+            f"{cell['shaped']['median_ms']:8.2f} ms "
+            f"(plan {cell['shaped']['plan']}) = {cell['speedup']}x",
+            file=sys.stderr,
+        )
+
+    artifact = {
+        "kind": "serve_shape_bench",
+        "params": type(params).__name__,
+        "padded_ladder": list(PADDED_LADDER),
+        "shaped_ladder": list(DEFAULT_BUCKETS),
+        "split_penalty_rows": shaped.split_penalty_rows,
+        "max_split": shaped.max_split,
+        "repeats": args.repeats,
+        "cells": rows,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    line = json.dumps(artifact, indent=1)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"artifact written to {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
