@@ -52,6 +52,32 @@ def _read_mat(path: str, backend: str) -> tuple[np.ndarray, np.ndarray]:
     return d["data_tb"], d["clin_var_names"]
 
 
+#: ``data_tb`` widths the bulk-scoring loader understands: the model's
+#: feature spaces bare (64 raw schema columns / 17 contract columns) or in
+#: the reference training layout with the outcome appended as the last
+#: column (65 / 18 — ``load_data_public.py:9-10``).
+_SCORE_WIDTHS = {64: 64, 65: 64, 17: 17, 18: 17}
+
+
+def load_feature_matrix(dataset_path: str, backend: str = "auto") -> np.ndarray:
+    """Feature matrix of a cohort ``.mat`` for label-free bulk scoring
+    (``score/``): accepts both bare feature matrices and the reference
+    training layout, stripping a trailing outcome column when one is
+    present. Width is the route signal downstream — 64 raw schema columns
+    run the full pipeline (impute → select → ensemble), 17 contract
+    columns the contract route."""
+    data, _ = _read_mat(dataset_path, backend)
+    width = data.shape[1]
+    feat = _SCORE_WIDTHS.get(width)
+    if feat is None:
+        raise ValueError(
+            f"{dataset_path!r}: data_tb is {width} columns wide; expected "
+            "64 raw schema features or 17 contract features (with or "
+            "without a trailing outcome column)"
+        )
+    return data[:, :feat].astype(np.float64)
+
+
 def save_data(
     dataset_path: str, X: np.ndarray, y: np.ndarray, var_names: np.ndarray
 ) -> None:

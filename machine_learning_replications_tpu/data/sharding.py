@@ -31,6 +31,31 @@ def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
     return np.pad(x, pad_width), n
 
 
+def pad_rows_to(
+    x: np.ndarray, rows: int, mode: str = "zero"
+) -> tuple[np.ndarray, int]:
+    """Pad axis 0 up to an exact row count — the fixed-chunk-shape variant
+    of ``pad_rows`` (the bulk-scoring device stage holds its
+    one-compile-for-the-run bound by padding every streamed chunk, tail
+    included, to one static shape). ``mode='edge'`` replicates the last
+    real row (the serving engine's padding: every predict path is a pure
+    per-row map, so replicated rows cannot perturb real ones and, unlike
+    zeros, cannot manufacture NaN/denormal edge cases in imputed feature
+    space); ``'zero'`` keeps ``pad_rows``'s masked-reduction semantics.
+    Returns ``(padded, n_real)``."""
+    n = x.shape[0]
+    if n > rows:
+        raise ValueError(f"cannot pad {n} rows down to {rows}")
+    if n == rows:
+        return x, n
+    if mode not in ("zero", "edge"):
+        raise ValueError(f"unknown pad mode {mode!r}; use 'zero' or 'edge'")
+    pad_width = [(0, rows - n)] + [(0, 0)] * (x.ndim - 1)
+    if mode == "edge" and n > 0:
+        return np.pad(x, pad_width, mode="edge"), n
+    return np.pad(x, pad_width), n
+
+
 def shard_rows(
     mesh: Mesh, *arrays: np.ndarray, axis: str = "data"
 ) -> tuple[tuple[jax.Array, ...] | jax.Array, int]:

@@ -676,6 +676,43 @@ def contract_rows_to_x64(
     return x64
 
 
+def resolve_contract_block_fn(params: PipelineParams):
+    """Pre-resolve the imputer's pattern-specialised block fn for
+    *contract-shaped* queries — 17 finite variables at their schema
+    positions, every other column NaN (``contract_rows_to_x64``). Contract
+    rows are all-finite by validation, so the NaN pattern is fixed and the
+    resolution — a device reduction plus a blocking device→host fetch of
+    the donor NaN mask — is a once-per-process cost instead of a per-batch
+    one. Both high-throughput front ends share this: the serving engine
+    (per flushed micro-batch) and the bulk-scoring pipeline (per streamed
+    chunk)."""
+    from machine_learning_replications_tpu.data.examples import (
+        EXAMPLE_PATIENT,
+    )
+    from machine_learning_replications_tpu.models import knn_impute
+
+    return knn_impute.resolve_block_fn(
+        params.imputer,
+        contract_rows_to_x64(
+            params, np.zeros((1, len(EXAMPLE_PATIENT)))
+        ),
+    )
+
+
+def support_feature_names(params: PipelineParams) -> list[str]:
+    """Schema variable names of the model's OWN lasso-selected columns, in
+    support-mask (ascending schema) order — the space ``impute_select``
+    emits and the quality reference profile was built over. NOT the
+    17-variable contract order: a checkpoint selects its own top-k, so
+    front ends labeling per-feature drift series (``serve/server.py``,
+    ``score/``) must derive names from the mask or name the wrong
+    variables."""
+    from machine_learning_replications_tpu.data.schema import variable_names
+
+    names = variable_names()
+    return [names[i] for i in np.where(np.asarray(params.support_mask))[0]]
+
+
 def impute_select(
     params: PipelineParams, X64: np.ndarray, mesh=None, block_fn=None
 ) -> jnp.ndarray:

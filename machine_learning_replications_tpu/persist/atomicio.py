@@ -1,0 +1,49 @@
+"""Durable small-file writes — the one atomic-JSON code path.
+
+The checkpoint layer already had the discipline (``orbax_io`` writes the
+integrity manifest and the sidecar with flush+fsync, and publishes whole
+trees via one ``os.rename``); the bulk-scoring progress manifest
+(``score/progress.py``) needs exactly the same crash contract for a single
+JSON file: a reader sees either the previous complete version or the new
+complete version, never a torn mix. This module is that pattern factored
+out — stdlib-only, jax-free, importable from anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+
+def fsync_json_dump(path: str | os.PathLike, obj: Any, indent: int = 1) -> None:
+    """Write ``obj`` as JSON at ``path`` with flush+fsync — durable but
+    NOT atomic (for files inside a tree that is itself published by one
+    rename, e.g. a checkpoint temp dir)."""
+    with open(os.fspath(path), "w") as f:
+        json.dump(obj, f, indent=indent)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def atomic_json_write(path: str | os.PathLike, obj: Any, indent: int = 1) -> None:
+    """Atomically replace ``path`` with ``obj`` as JSON: full content into
+    a same-directory temp file (fsync'd), then one ``os.replace``. A crash
+    at any point leaves the previous version intact."""
+    path = os.path.abspath(os.fspath(path))
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", dir=os.path.dirname(path)
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=indent)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

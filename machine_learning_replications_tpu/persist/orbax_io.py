@@ -102,7 +102,9 @@ def _write_integrity(path: str) -> None:
     """Content-checksum manifest over the finished checkpoint tree
     (sha256 + byte size per file). Written last in the temp dir, before
     the atomic publish rename."""
-    import json
+    from machine_learning_replications_tpu.persist.atomicio import (
+        fsync_json_dump,
+    )
 
     files = {}
     for rel in _payload_files(path):
@@ -110,11 +112,9 @@ def _write_integrity(path: str) -> None:
         files[rel] = {
             "sha256": _file_sha256(fp), "bytes": os.path.getsize(fp),
         }
-    fp = os.path.join(path, _INTEGRITY_FILE)
-    with open(fp, "w") as f:
-        json.dump({"format": 1, "files": files}, f, indent=1)
-        f.flush()
-        os.fsync(f.fileno())
+    fsync_json_dump(
+        os.path.join(path, _INTEGRITY_FILE), {"format": 1, "files": files}
+    )
 
 
 def verify_checkpoint(path: str | os.PathLike, *, deep: bool = True) -> bool:
@@ -366,15 +366,16 @@ def save_model(path: str | os.PathLike, params: Any) -> None:
     (``StageCheckpointer.completed``), and it is covered by the integrity
     manifest, so a present sidecar implies a complete, checksummed
     checkpoint."""
-    import json
+    from machine_learning_replications_tpu.persist.atomicio import (
+        fsync_json_dump,
+    )
 
     def write_tree(tmp: str) -> None:
         _orbax_save(tmp, params)
-        sidecar = {"format": 1, "root": _encode_template(params)}
-        with open(os.path.join(tmp, _TEMPLATE_FILE), "w") as f:
-            json.dump(sidecar, f, indent=1)
-            f.flush()
-            os.fsync(f.fileno())
+        fsync_json_dump(
+            os.path.join(tmp, _TEMPLATE_FILE),
+            {"format": 1, "root": _encode_template(params)},
+        )
 
     _publish_tree(os.path.abspath(os.fspath(path)), write_tree)
 

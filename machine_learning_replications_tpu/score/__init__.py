@@ -1,0 +1,42 @@
+"""Population-scale bulk scoring (L7 — the "nightly rescore every patient"
+workload; docs/SCORING.md).
+
+The serving layer (``serve/``) answers *requests*: single patients and
+micro-batches under a latency SLO. This package answers *cohorts*: stream
+a multi-million-row patient file (JSONL patient dicts or a reference-layout
+``.mat``) through the same mesh-shardable predict tail as ``cli predict``,
+with
+
+  * a pipelined producer/consumer architecture — reader + parse workers
+    doing host work (parse, validate, quarantine, impute-route) feed a
+    bounded prefetch queue; the device stage double-buffers ``device_put``
+    so chunk N+1 transfers while chunk N computes at one fixed padded
+    chunk shape (one XLA compile for the whole run); an ordered writer
+    drains results to sharded output files;
+  * resumability — per-chunk journal events plus an atomic progress
+    manifest (``score/progress.py``, the ``persist/orbax_io.py`` integrity-
+    publish style), so a killed run restarts at the last committed chunk
+    with zero re-scored and zero skipped rows, byte-identical to an
+    uninterrupted run;
+  * observability — per-stage spans (``obs/spans.py``), ``score_*`` metric
+    families (``obs/registry.py``), and the model-quality monitor
+    (``obs/quality.py``) running over the full scored population instead
+    of a serving window.
+
+Entry point: ``cli.py score``; bench: ``tools/score_bench.py``.
+"""
+
+from machine_learning_replications_tpu.score.pipeline import (  # noqa: F401
+    ScorePipeline,
+    ScoreBudgetExceeded,
+    ScoreInterrupted,
+)
+from machine_learning_replications_tpu.score.reader import (  # noqa: F401
+    JsonlCohortSource,
+    MatCohortSource,
+    open_cohort,
+)
+from machine_learning_replications_tpu.score.progress import (  # noqa: F401
+    ScoreProgress,
+    ScoreResumeError,
+)

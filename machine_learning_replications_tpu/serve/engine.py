@@ -163,8 +163,6 @@ class BucketedPredictEngine:
         # upload's bytes (jax_transfer_bytes_total{direction="h2d"}).
         dparams = jaxmon.device_put(params)
         if isinstance(params, pipeline.PipelineParams):
-            from machine_learning_replications_tpu.models import knn_impute
-
             # ... except the support mask, which stays host-resident:
             # impute_select np.where's it per call, and a device mask
             # would cost a blocking device-to-host sync per flushed batch.
@@ -177,13 +175,8 @@ class BucketedPredictEngine:
             # resolution reduces the donor NaN mask on device and blocks
             # on its fetch, a cost that must not recur per flushed batch
             # (it would dominate the max_wait_ms budget on remote
-            # backends).
-            contract_block_fn = knn_impute.resolve_block_fn(
-                params.imputer,
-                pipeline.contract_rows_to_x64(
-                    params, np.zeros((1, self.n_features))
-                ),
-            )
+            # backends). Shared with the bulk-scoring pipeline.
+            contract_block_fn = pipeline.resolve_contract_block_fn(params)
             # Full-pipeline route: host-orchestrated imputation feeding
             # the jitted stacked-probability core. One imputer compile +
             # one core compile per bucket. The core also returns the

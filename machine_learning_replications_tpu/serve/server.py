@@ -959,16 +959,12 @@ def make_server(
             # or every quality_feature_psi series (and the /debug/quality
             # worst-offender table) names the wrong variable.
             feature_names = None
-            support_mask = getattr(params, "support_mask", None)
-            if support_mask is not None:
-                from machine_learning_replications_tpu.data.schema import (
-                    variable_names,
+            if getattr(params, "support_mask", None) is not None:
+                from machine_learning_replications_tpu.models.pipeline import (
+                    support_feature_names,
                 )
 
-                names = variable_names()
-                feature_names = [
-                    names[i] for i in np.where(np.asarray(support_mask))[0]
-                ]
+                feature_names = support_feature_names(params)
             # Fail at startup, not on the first flush: a profile whose
             # width doesn't match the rows the engine will feed (e.g. one
             # built over a pre-selection 64-column matrix attached to a

@@ -101,9 +101,10 @@ def test_block_fn_specialisation_resolution(cohort):
     captured = {}
     orig = knn_impute._block_fn
 
-    def spy(nan_cols, masked):
+    def spy(nan_cols, masked, dist_cols=None):
         captured["nan_cols"], captured["masked"] = nan_cols, masked
-        return orig(nan_cols, masked)
+        captured["dist_cols"] = dist_cols
+        return orig(nan_cols, masked, dist_cols)
 
     knn_impute._block_fn, _restore = spy, orig
     try:
@@ -113,6 +114,9 @@ def test_block_fn_specialisation_resolution(cohort):
 
     assert set(captured["nan_cols"]) == q_nan_cols
     assert set(captured["masked"]) == q_nan_cols & donor_nan_cols
+    # Partial missingness (NaN columns still hold some values): the
+    # restricted-distance specialisation must NOT engage.
+    assert captured["dist_cols"] is None
 
     # complete donors -> empty masked set even when queries have NaN
     X_complete = np.where(np.isnan(X_np), np.nanmean(X_np, axis=0), X_np)
@@ -124,6 +128,43 @@ def test_block_fn_specialisation_resolution(cohort):
         knn_impute._block_fn = _restore
     assert captured["masked"] == ()
     assert set(captured["nan_cols"]) == q_nan_cols
+
+
+def test_block_fn_fully_missing_columns_fast_path(cohort):
+    """The contract-row shape (every NaN column fully missing) engages the
+    restricted-distance + per-column-argmin specialisation; its output
+    must be BIT-identical to the unrestricted top-K form — the imputed
+    values are copied donor values, so identical selections mean
+    identical bytes (the bulk-scoring / serving parity contract)."""
+    import numpy as np
+
+    X, _, _ = cohort
+    X_np = np.asarray(X)
+    params = knn_impute.fit(jnp.asarray(X_np))
+    # Build a contract-like query block: values only in 17 columns, the
+    # other 47 fully NaN.
+    rng = np.random.default_rng(3)
+    keep = np.sort(rng.choice(X_np.shape[1], size=17, replace=False))
+    Xq = np.full((64, X_np.shape[1]), np.nan)
+    Xq[:, keep] = np.nan_to_num(X_np[:64, keep], nan=1.0)
+    nan_cols = tuple(int(c) for c in np.flatnonzero(np.isnan(Xq).any(axis=0)))
+    donor_nan = np.isnan(np.asarray(params.donors)).any(axis=0)
+    masked = tuple(int(c) for c in nan_cols if donor_nan[c])
+    resolved = knn_impute._block_fn_for(params, Xq)
+    # The specialisation engaged (cache key includes dist_cols).
+    assert resolved is knn_impute._block_fn(nan_cols, masked, tuple(
+        int(c) for c in keep
+    ))
+    full = np.asarray(
+        knn_impute._block_fn(nan_cols, masked, None)(params, jnp.asarray(Xq))
+    )
+    fast = np.asarray(resolved(params, jnp.asarray(Xq)))
+    np.testing.assert_array_equal(fast, full)
+    # And both match the brute-force sklearn-semantics oracle.
+    oracle = _impute_oracle(
+        np.asarray(params.donors), np.asarray(params.col_means), Xq
+    )
+    np.testing.assert_allclose(fast, oracle, rtol=0, atol=0)
 
 
 def _impute_oracle(donors, col_means, Xq):

@@ -1,0 +1,679 @@
+"""Bulk-scoring pipeline tests (score/ + cli score — docs/SCORING.md).
+
+The load-bearing contracts, each pinned here:
+
+  * **Parity** — `cli score` output is bit-identical to the `cli predict`
+    oracle on the same rows, for the contract route (JSONL patients /
+    bare ensembles) and the raw-x64 route (.mat through the full
+    pipeline), whatever the chunking.
+  * **Resume** — a run killed mid-cohort restarts at the last committed
+    chunk and produces byte-identical output to an uninterrupted run: no
+    duplicated rows, no missing rows, quarantine sidecar included.
+  * **Malformed-row policy** — bad lines quarantine with line numbers and
+    the run continues; the bounded error budget aborts loudly.
+  * **Overlap is a pure optimization** — the overlapped pipeline's output
+    equals the sequential ablation's, byte for byte.
+  * **Telemetry** — score_* families are strict-exposition-clean and the
+    cohort-level quality snapshot runs over the scored population.
+"""
+
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+try:
+    import validate_metrics
+finally:
+    sys.path.pop(0)
+
+import jax.numpy as jnp
+
+from machine_learning_replications_tpu.data import make_cohort
+from machine_learning_replications_tpu.data.schema import (
+    SELECTED_17,
+    selected_indices,
+)
+from machine_learning_replications_tpu.score import (
+    JsonlCohortSource,
+    ScoreBudgetExceeded,
+    ScorePipeline,
+    ScoreResumeError,
+    open_cohort,
+)
+from machine_learning_replications_tpu.score.pipeline import ScoreInterrupted
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a fast real ensemble + a hand-assembled full pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stacking_params():
+    """sklearn-fitted stacking ensemble imported into our pytrees — the
+    contract-route (17-column) scoring family."""
+    from sklearn.ensemble import (
+        GradientBoostingClassifier,
+        StackingClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    from machine_learning_replications_tpu.persist import import_stacking
+
+    rng = np.random.default_rng(7)
+    n, f = 200, 17
+    X = rng.normal(size=(n, f))
+    X[:, :10] = (X[:, :10] > 0.3).astype(float)
+    y = (X @ rng.normal(size=f) + rng.normal(size=n) > 0.2).astype(float)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = StackingClassifier(
+            estimators=[
+                ("svc", make_pipeline(
+                    StandardScaler(), SVC(probability=True, random_state=0)
+                )),
+                ("gbc", GradientBoostingClassifier(
+                    n_estimators=5, max_depth=1, random_state=0)),
+                ("lg", LogisticRegression()),
+            ],
+            final_estimator=LogisticRegression(),
+        ).fit(X, y)
+    return import_stacking(clf)
+
+
+@pytest.fixture(scope="module")
+def pipeline_params(stacking_params):
+    """A full PipelineParams assembled from real fitted pieces (KNN
+    imputer over a NaN-bearing cohort, contract support mask, the module's
+    sklearn ensemble, a genuine reference profile) — the x64/pipeline
+    scoring family, WITHOUT paying a whole fit_pipeline in tier-1 time."""
+    from machine_learning_replications_tpu.models import (
+        knn_impute, pipeline, stacking,
+    )
+    from machine_learning_replications_tpu.obs import quality
+
+    X64, y, _ = make_cohort(n=300, seed=3, missing_rate=0.05)
+    imp, X_imp = knn_impute.fit_transform(jnp.asarray(X64))
+    mask = np.zeros(64, bool)
+    mask[selected_indices()] = True
+    X17 = np.asarray(X_imp)[:, np.where(mask)[0]]
+    scores = np.asarray(
+        stacking.predict_proba1(stacking_params, jnp.asarray(X17))
+    )
+    prof = quality.build_reference_profile(X17, scores, y=y)
+    return pipeline.PipelineParams(
+        imputer=imp,
+        support_mask=jnp.asarray(mask),
+        ensemble=stacking_params,
+        quality={k: jnp.asarray(v) for k, v in prof.items()},
+    )
+
+
+@pytest.fixture(scope="module")
+def cohort_rows():
+    """500 contract-order rows drawn from the schema-matched generator."""
+    X64, _, _ = make_cohort(n=500, seed=11, missing_rate=0.0)
+    return X64[:, selected_indices()]
+
+
+def _write_jsonl(path, rows, bad_at=()):
+    """Patient-dict JSONL; ``bad_at`` inserts malformed lines BEFORE the
+    given 0-based row positions. Returns total line count."""
+    bad_cycle = [
+        "{definitely not json",
+        json.dumps({"Gender": 1}),                      # missing variables
+        json.dumps(dict(zip(SELECTED_17, [None] * 17))),  # non-numeric
+        "",                                              # empty line
+    ]
+    lines = 0
+    with open(path, "w") as f:
+        for i, row in enumerate(rows):
+            if i in bad_at:
+                f.write(bad_cycle[lines % len(bad_cycle)] + "\n")
+                lines += 1
+            f.write(json.dumps(
+                {k: float(v) for k, v in zip(SELECTED_17, row)}
+            ) + "\n")
+            lines += 1
+    return lines
+
+
+def _run(params, cohort_path, out_dir, chunk_rows=64, **kw):
+    kw.setdefault("model_digest", "test-model")
+    kw.setdefault("rows_per_shard", 150)
+    src = open_cohort(str(cohort_path), chunk_rows)
+    return ScorePipeline(params, src, str(out_dir), **kw).run()
+
+
+def _read_scores(out_dir):
+    """All committed score records across shards, in order."""
+    recs = []
+    for name in sorted(os.listdir(out_dir)):
+        if name.startswith("scores-") and name.endswith(".jsonl"):
+            with open(os.path.join(out_dir, name)) as f:
+                recs += [json.loads(line) for line in f]
+    return recs
+
+
+def _tree_bytes(out_dir):
+    """Concatenated bytes of every output shard + the quarantine sidecar
+    — the byte-identical-resume comparison domain."""
+    out = b""
+    names = sorted(
+        n for n in os.listdir(out_dir)
+        if n.startswith("scores-") or n == "quarantine.jsonl"
+    )
+    for name in names:
+        with open(os.path.join(out_dir, name), "rb") as f:
+            out += name.encode() + b"\0" + f.read() + b"\0"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reader + quarantine policy
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_reader_chunks_lines_and_quarantine(tmp_path, cohort_rows):
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:100], bad_at=(5, 50))
+    src = JsonlCohortSource(str(path), chunk_rows=32)
+    chunks = [src.parse(b) for b in src.blocks()]
+    # 102 lines → 32/32/32/6; every line consumed exactly once.
+    assert [c.lines_consumed for c in chunks] == [32, 32, 32, 6]
+    assert sum(c.n_rows for c in chunks) == 100
+    assert sum(len(c.bad) for c in chunks) == 2
+    # Quarantine entries carry the malformed lines' 1-based numbers: the
+    # inserts landed before rows 5 and 50, i.e. lines 6 and 52.
+    bad_lines = [line for c in chunks for (line, _err, _raw) in c.bad]
+    assert bad_lines == [6, 52]
+    # Valid rows carry their own input line numbers, gaps skipped.
+    all_lines = np.concatenate([c.line_nos for c in chunks])
+    assert len(all_lines) == 100
+    assert 6 not in all_lines and 52 not in all_lines
+    # Values round-trip exactly.
+    row0 = chunks[0].X[0]
+    np.testing.assert_array_equal(row0, cohort_rows[0])
+
+
+def test_reader_skip_lines_resume_alignment(tmp_path, cohort_rows):
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:100])
+    src = JsonlCohortSource(str(path), chunk_rows=32)
+    full = [src.parse(b) for b in src.blocks()]
+    resumed = [src.parse(b) for b in src.blocks(skip_lines=64, start_seq=2)]
+    assert [c.seq for c in resumed] == [2, 3]
+    np.testing.assert_array_equal(resumed[0].X, full[2].X)
+    np.testing.assert_array_equal(resumed[0].line_nos, full[2].line_nos)
+
+
+def test_budget_abort(tmp_path, stacking_params, cohort_rows):
+    path = tmp_path / "bad.jsonl"
+    _write_jsonl(path, cohort_rows[:60], bad_at=(1, 2, 3, 4, 5))
+    with pytest.raises(ScoreBudgetExceeded):
+        _run(
+            stacking_params, path, tmp_path / "out",
+            chunk_rows=16, max_bad_rows=3, overlap=False,
+        )
+    # The run aborted resumable: nothing says 'done'.
+    prog = json.load(open(tmp_path / "out" / "progress.json")) if (
+        tmp_path / "out" / "progress.json"
+    ).exists() else {"done": False}
+    assert not prog.get("done")
+
+
+def test_budget_abort_flushes_triggering_rows(
+    tmp_path, stacking_params, cohort_rows
+):
+    """The chunk that blows the budget never commits, but its offending
+    rows must still reach the sidecar the abort message points at."""
+    path = tmp_path / "bad.jsonl"
+    _write_jsonl(path, cohort_rows[:40], bad_at=(2, 3))
+    out = tmp_path / "out"
+    with pytest.raises(ScoreBudgetExceeded):
+        _run(
+            stacking_params, path, out, chunk_rows=64, max_bad_rows=1,
+            overlap=False,
+        )
+    entries = [json.loads(line) for line in open(out / "quarantine.jsonl")]
+    assert len(entries) == 2 and all(e["error"] for e in entries)
+
+
+def test_bare_ensemble_mat_nan_rows_quarantined(tmp_path, stacking_params):
+    """A 17-wide .mat cohort with NaNs scored by a bare ensemble (no
+    imputer) must quarantine the non-finite rows — not write invalid
+    JSON shard lines like {"p1": nan}."""
+    scipy_io = pytest.importorskip("scipy.io")
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(50, 17))
+    X[7, 3] = np.nan
+    X[31, 0] = np.nan
+    path = tmp_path / "cohort17.mat"
+    scipy_io.savemat(str(path), {
+        "data_tb": X, "clin_var_names": np.empty((1, 0), object),
+    })
+    out = tmp_path / "out"
+    summary = _run(stacking_params, path, out, chunk_rows=16)
+    assert summary["rows"] == 48 and summary["bad_rows"] == 2
+    recs = _read_scores(out)  # every line must be strict JSON
+    assert len(recs) == 48
+    assert all(np.isfinite(r["p1"]) for r in recs)
+    quar = [json.loads(line) for line in open(out / "quarantine.jsonl")]
+    assert {q["line"] for q in quar} == {8, 32}  # 1-based rows
+    assert all("non-finite" in q["error"] for q in quar)
+
+
+def test_fresh_start_clears_stale_summary(
+    tmp_path, stacking_params, cohort_rows
+):
+    """A new run into a directory holding a FINISHED run's outputs must
+    not leave the old summary/quality behind: an early abort would
+    otherwise attribute the previous run's verdict to this one."""
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:200])
+    out = tmp_path / "out"
+    _run(stacking_params, path, out, chunk_rows=64)
+    assert (out / "summary.json").exists()
+    with pytest.raises(ScoreInterrupted):
+        _run(
+            stacking_params, path, out, chunk_rows=64,
+            _interrupt_after_chunks=1,
+        )
+    assert not (out / "summary.json").exists()
+
+
+def test_quarantine_sidecar_contents(tmp_path, stacking_params, cohort_rows):
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:80], bad_at=(10, 40))
+    out = tmp_path / "out"
+    summary = _run(
+        stacking_params, path, out, chunk_rows=32, overlap=False,
+    )
+    assert summary["bad_rows"] == 2
+    assert summary["rows"] == 80
+    entries = [
+        json.loads(line) for line in open(out / "quarantine.jsonl")
+    ]
+    # Inserts landed before rows 10 and 40 → input lines 11 and 42
+    # (the second insert follows 40 valid rows + the first bad line).
+    assert [e["line"] for e in entries] == [11, 42]
+    assert all(e["error"] for e in entries)
+
+
+# ---------------------------------------------------------------------------
+# parity: bit-identical to the cli predict oracle, both routes
+# ---------------------------------------------------------------------------
+
+
+def test_contract_route_parity_bitwise(
+    tmp_path, stacking_params, cohort_rows
+):
+    from machine_learning_replications_tpu.models import stacking
+
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows)
+    out = tmp_path / "out"
+    summary = _run(stacking_params, path, out, chunk_rows=64)
+    assert summary["rows"] == len(cohort_rows)
+    expect = np.asarray(
+        stacking.predict_proba1(stacking_params, jnp.asarray(cohort_rows))
+    )
+    got = np.asarray([r["p1"] for r in _read_scores(out)])
+    np.testing.assert_array_equal(got, expect)  # bitwise, not approx
+
+
+def test_pipeline_route_parity_bitwise(
+    tmp_path, pipeline_params, cohort_rows
+):
+    """JSONL contract dicts through a full-pipeline checkpoint: embed at
+    schema positions → KNN-impute → support gather → stacked blend — must
+    equal pipeline_predict_proba1_contract (the cli predict --model
+    route) bit for bit."""
+    from machine_learning_replications_tpu.models import pipeline
+
+    rows = cohort_rows[:200]
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, rows)
+    out = tmp_path / "out"
+    summary = _run(pipeline_params, path, out, chunk_rows=64)
+    assert summary["route"] == "contract"
+    expect = np.asarray(
+        pipeline.pipeline_predict_proba1_contract(pipeline_params, rows)
+    )
+    got = np.asarray([r["p1"] for r in _read_scores(out)])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_mat_x64_route_parity_bitwise(tmp_path, pipeline_params):
+    """A reference-layout .mat cohort (64 raw columns + outcome, NaNs for
+    the imputer) through the x64 route equals pipeline_predict_proba1."""
+    scipy_io = pytest.importorskip("scipy.io")
+    from machine_learning_replications_tpu.data.schema import variable_names
+    from machine_learning_replications_tpu.models import pipeline
+
+    X64, y, _ = make_cohort(n=150, seed=23, missing_rate=0.04)
+    path = tmp_path / "cohort.mat"
+    scipy_io.savemat(str(path), {
+        "data_tb": np.concatenate([X64, y.reshape(-1, 1)], axis=1),
+        "clin_var_names": np.array([variable_names()], dtype=object),
+    })
+    out = tmp_path / "out"
+    summary = _run(pipeline_params, path, out, chunk_rows=64)
+    assert summary["route"] == "x64"
+    assert summary["rows"] == 150
+    expect = np.asarray(
+        pipeline.pipeline_predict_proba1(pipeline_params, X64)
+    )
+    got = np.asarray([r["p1"] for r in _read_scores(out)])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_x64_route_requires_pipeline_params(tmp_path, stacking_params):
+    scipy_io = pytest.importorskip("scipy.io")
+    X64, _, _ = make_cohort(n=20, seed=5, missing_rate=0.0)
+    path = tmp_path / "cohort.mat"
+    scipy_io.savemat(str(path), {
+        "data_tb": X64, "clin_var_names": np.empty((1, 0), object),
+    })
+    with pytest.raises(TypeError, match="PipelineParams"):
+        _run(stacking_params, path, tmp_path / "out", overlap=False)
+
+
+# ---------------------------------------------------------------------------
+# overlap vs sequential, shards, compile bound
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_equals_sequential_bytes(
+    tmp_path, stacking_params, cohort_rows
+):
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows, bad_at=(17, 333))
+    seq = _run(
+        stacking_params, path, tmp_path / "seq", chunk_rows=64,
+        overlap=False,
+    )
+    ovl = _run(
+        stacking_params, path, tmp_path / "ovl", chunk_rows=64,
+        overlap=True, parse_workers=3, prefetch=3,
+    )
+    assert seq["output_sha256"] == ovl["output_sha256"]
+    assert _tree_bytes(tmp_path / "seq") == _tree_bytes(tmp_path / "ovl")
+    assert ovl["rows"] == seq["rows"] == len(cohort_rows)
+    # Per-stage accounting exists in both modes.
+    for s in (seq, ovl):
+        assert set(s["stage_seconds"]) >= {"read", "parse", "device", "write"}
+
+
+def test_process_parse_mode_identical(tmp_path, stacking_params, cohort_rows):
+    """parse_procs swaps the parse threads for spawned worker processes
+    (GIL-free ingest); the output — shards and quarantine sidecar — must
+    be byte-identical to the thread mode's."""
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:200], bad_at=(30, 90))
+    thr = _run(stacking_params, path, tmp_path / "thr", chunk_rows=64)
+    proc = _run(
+        stacking_params, path, tmp_path / "proc", chunk_rows=64,
+        parse_procs=1,
+    )
+    assert proc["parse_procs"] == 1 and thr["parse_procs"] == 0
+    assert proc["output_sha256"] == thr["output_sha256"]
+    assert _tree_bytes(tmp_path / "proc") == _tree_bytes(tmp_path / "thr")
+    assert proc["bad_rows"] == 2
+
+
+def test_shard_rotation_and_row_ids(tmp_path, stacking_params, cohort_rows):
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows)
+    out = tmp_path / "out"
+    summary = _run(
+        stacking_params, path, out, chunk_rows=64, rows_per_shard=120,
+    )
+    # 500 rows over 120-row shards → 5 shards (120×4 + 20).
+    assert [s["rows"] for s in summary["shards"]] == [120, 120, 120, 120, 20]
+    recs = _read_scores(out)
+    assert [r["row"] for r in recs] == list(range(500))
+    assert [r["line"] for r in recs] == list(range(1, 501))
+    for s in summary["shards"]:
+        assert os.path.getsize(out / s["name"]) == s["bytes"]
+
+
+def test_mesh_sharded_route(tmp_path, stacking_params, cohort_rows):
+    """--mesh routes the stacked pass through the row-sharded predict
+    tail (apply_rows_sharded over the conftest 8-virtual-device mesh);
+    the scored cohort must match the single-device oracle."""
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.parallel import make_mesh
+
+    rows = cohort_rows[:200]
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, rows)
+    out = tmp_path / "out"
+    summary = _run(
+        stacking_params, path, out, chunk_rows=64, mesh=make_mesh(),
+    )
+    assert summary["mesh"] and summary["rows"] == 200
+    expect = np.asarray(
+        stacking.predict_proba1(stacking_params, jnp.asarray(rows))
+    )
+    got = np.asarray([r["p1"] for r in _read_scores(out)])
+    np.testing.assert_allclose(got, expect, rtol=0, atol=1e-12)
+
+
+def test_fixed_chunk_shape_compile_bound(
+    tmp_path, stacking_params, cohort_rows
+):
+    """Every chunk runs at ONE padded shape, so a second cohort scored in
+    the same process compiles nothing new — the engine's
+    one-compile-per-bucket bound at chunk granularity."""
+    from machine_learning_replications_tpu.obs import jaxmon
+
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:300])
+    _run(stacking_params, path, tmp_path / "warm", chunk_rows=64)
+    before = jaxmon.compile_count()
+    _run(stacking_params, path, tmp_path / "again", chunk_rows=64)
+    assert jaxmon.compile_count() == before
+
+
+# ---------------------------------------------------------------------------
+# resume
+# ---------------------------------------------------------------------------
+
+
+def test_kill_resume_byte_identical(tmp_path, stacking_params, cohort_rows):
+    from machine_learning_replications_tpu.obs import journal
+
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows, bad_at=(100, 260))
+    golden = _run(
+        stacking_params, path, tmp_path / "golden", chunk_rows=64,
+    )
+    out = tmp_path / "out"
+    with pytest.raises(ScoreInterrupted):
+        _run(
+            stacking_params, path, out, chunk_rows=64,
+            _interrupt_after_chunks=3,
+        )
+    prog = json.load(open(out / "progress.json"))
+    assert prog["chunks"] >= 3 and not prog["done"]
+    jrn_path = tmp_path / "resume.jsonl"
+    jrn = journal.RunJournal(str(jrn_path), command="score")
+    journal.set_journal(jrn)
+    try:
+        resumed = _run(stacking_params, path, out, chunk_rows=64)
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+    assert resumed["resumed"] and resumed["resumed_chunks"] >= 3
+    assert resumed["rows"] == golden["rows"] == len(cohort_rows)
+    assert resumed["output_sha256"] == golden["output_sha256"]
+    assert _tree_bytes(out) == _tree_bytes(tmp_path / "golden")
+    events = [json.loads(line) for line in open(jrn_path)]
+    kinds = [e.get("kind") for e in events]
+    assert "score_resume" in kinds and "score_done" in kinds
+    assert kinds.count("score_chunk") == resumed["chunks"] - resumed[
+        "resumed_chunks"
+    ]
+
+
+def test_resume_truncates_uncommitted_tail(
+    tmp_path, stacking_params, cohort_rows
+):
+    """A crash AFTER appending but BEFORE the manifest commit (the real
+    kill -9 window) leaves stray bytes past the committed prefix; resume
+    must truncate them, not double-score."""
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:300])
+    golden = _run(stacking_params, path, tmp_path / "golden", chunk_rows=64)
+    out = tmp_path / "out"
+    with pytest.raises(ScoreInterrupted):
+        _run(
+            stacking_params, path, out, chunk_rows=64,
+            _interrupt_after_chunks=2,
+        )
+    # Emulate the torn post-commit write.
+    shard = sorted(
+        n for n in os.listdir(out) if n.startswith("scores-")
+    )[-1]
+    with open(out / shard, "ab") as f:
+        f.write(b'{"row":999999,"line":999999,"p1":0.5}\n')
+    resumed = _run(stacking_params, path, out, chunk_rows=64)
+    assert resumed["output_sha256"] == golden["output_sha256"]
+    assert _tree_bytes(out) == _tree_bytes(tmp_path / "golden")
+
+
+def test_resume_fingerprint_mismatch(tmp_path, stacking_params, cohort_rows):
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:200])
+    out = tmp_path / "out"
+    with pytest.raises(ScoreInterrupted):
+        _run(
+            stacking_params, path, out, chunk_rows=64,
+            _interrupt_after_chunks=1,
+        )
+    # Different chunk geometry → different commit points → refuse.
+    with pytest.raises(ScoreResumeError, match="chunk_rows"):
+        _run(stacking_params, path, out, chunk_rows=32)
+    # Different model identity → refuse.
+    with pytest.raises(ScoreResumeError, match="params"):
+        _run(
+            stacking_params, path, out, chunk_rows=64,
+            model_digest="other-model",
+        )
+    # --fresh discards and completes.
+    summary = _run(
+        stacking_params, path, out, chunk_rows=32, fresh=True,
+    )
+    assert not summary["resumed"] and summary["rows"] == 200
+
+
+# ---------------------------------------------------------------------------
+# telemetry: metrics exposition + cohort quality
+# ---------------------------------------------------------------------------
+
+
+def test_score_metrics_exposition_valid(
+    tmp_path, stacking_params, cohort_rows
+):
+    from machine_learning_replications_tpu.obs.registry import REGISTRY
+
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:200], bad_at=(3,))
+    _run(stacking_params, path, tmp_path / "out", chunk_rows=64)
+    text = REGISTRY.render_prometheus()
+    assert validate_metrics.validate(text) == []
+    for family in (
+        "score_rows_total", "score_chunks_total",
+        "score_quarantined_rows_total", "score_chunk_seconds",
+        "score_queue_depth", "score_stage_seconds_total",
+    ):
+        assert family in text
+
+
+def test_cohort_quality_snapshot(tmp_path, pipeline_params, cohort_rows):
+    rows = cohort_rows[:250]
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, rows)
+    out = tmp_path / "out"
+    summary = _run(
+        pipeline_params, path, out, chunk_rows=64, quality_window=4096,
+    )
+    q = summary["quality"]
+    assert q is not None and q["enabled"]
+    assert q["status"] in ("ok", "warn", "alert")
+    assert q["rows"] == 250
+    snap = json.load(open(out / "quality.json"))
+    assert snap["rows_total"] == 250
+    assert len(snap["features"]) == 17
+    # Feature labels are the model's own selected schema variables.
+    names = {f["name"] for f in snap["features"]}
+    assert "Max_Wall_Thick" in names
+
+
+def test_quality_absent_for_bare_ensemble(
+    tmp_path, stacking_params, cohort_rows
+):
+    path = tmp_path / "cohort.jsonl"
+    _write_jsonl(path, cohort_rows[:60])
+    summary = _run(
+        stacking_params, path, tmp_path / "out", chunk_rows=64,
+        overlap=False,
+    )
+    assert summary["quality"] is None
+    assert not (tmp_path / "out" / "quality.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# cli end-to-end (in-process main), incl. the cli predict join
+# ---------------------------------------------------------------------------
+
+
+def test_cli_score_end_to_end(
+    tmp_path, pipeline_params, cohort_rows, capsys
+):
+    from machine_learning_replications_tpu import cli
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    ckpt = tmp_path / "ckpt"
+    orbax_io.save_model(str(ckpt), pipeline_params)
+    rows = cohort_rows[:130]
+    cohort = tmp_path / "cohort.jsonl"
+    _write_jsonl(cohort, rows, bad_at=(7,))
+    out = tmp_path / "out"
+    metrics = tmp_path / "metrics.txt"
+    rc = cli.main([
+        "score", "--model", str(ckpt), "--cohort", str(cohort),
+        "--out", str(out), "--chunk-rows", "64",
+        "--quality-window", "4096", "--metrics-out", str(metrics),
+    ])
+    assert rc == 0
+    printed = capsys.readouterr()
+    assert "scored 130 rows" in printed.out
+    summary = json.load(open(out / "summary.json"))
+    assert summary["rows"] == 130 and summary["bad_rows"] == 1
+    assert validate_metrics.validate(open(metrics).read()) == []
+
+    # The cli predict join: the same patient through `predict --model`
+    # prints the same probability the score shard recorded.
+    recs = _read_scores(out)
+    pick = recs[41]
+    patient = tmp_path / "patient.json"
+    with open(patient, "w") as f:
+        json.dump(
+            {k: float(v) for k, v in zip(SELECTED_17, rows[41])}, f
+        )
+    rc = cli.main([
+        "predict", "--model", str(ckpt), "--patient", str(patient),
+    ])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert f"{100.0 * pick['p1']:.2f} %" in printed

@@ -25,6 +25,12 @@ Sources (mix live and file freely; stdlib only):
                    section: drift status, worst features, calibration,
                    journaled status transitions)
   --bench PATH     a loadgen SERVE_BENCH_*.json artifact (enables the join)
+  --score          render the "Bulk scoring" section: the cli score run's
+                   journal (score_resume / score_chunk / score_done), the
+                   cohort-level quality snapshot (--quality then points at
+                   the run's quality.json), and --score-bench for the
+                   SCORE_BENCH_*.json sequential-vs-overlapped cells
+  --score-bench PATH  a tools/score_bench.py artifact
   --out PATH       write the report there (default: stdout)
 
 Example:
@@ -274,6 +280,101 @@ def _section_quality(
         )
 
 
+def _section_score(
+    rep: Report, events: list[dict], quality: dict | None,
+    score_bench: dict | None,
+):
+    """The "Bulk scoring" section: one `cli score` run's story — journal
+    digest (resume provenance, chunk cadence, end-to-end rows/s), the
+    cohort-level quality snapshot, and the sequential-vs-overlapped bench
+    cells — in the same shape as the r9 serving quality section."""
+    rep.h("Bulk scoring")
+    done = next(
+        (e for e in reversed(events) if e.get("kind") == "score_done"), None
+    )
+    resumes = [e for e in events if e.get("kind") == "score_resume"]
+    chunks = [e for e in events if e.get("kind") == "score_chunk"]
+    if done is None and not chunks and score_bench is None:
+        rep.kv("bulk scoring", "unavailable (no score journal / "
+               "--score-bench)")
+        return
+    if done is not None:
+        rep.kv(
+            "scored",
+            f"{done.get('rows')} rows in {done.get('chunks')} chunks "
+            f"({done.get('bad_rows')} quarantined)",
+        )
+        rep.kv("end-to-end rate", f"{done.get('rows_per_second')} rows/s "
+               f"over {done.get('wall_seconds')} s")
+        sha = done.get("output_sha256")
+        if sha:
+            rep.kv("output sha256", sha[:16] + "…")
+    if resumes:
+        for e in resumes:
+            rep.kv(
+                "resume", f"re-entered at chunk {e.get('chunks')} "
+                f"({e.get('rows')} rows already committed) at {e.get('ts')}",
+            )
+    elif done is not None:
+        rep.kv("resume", "none (uninterrupted run)")
+    if chunks:
+        secs = [e["seconds"] for e in chunks if e.get("seconds") is not None]
+        if secs:
+            rep.kv(
+                "chunk cadence",
+                f"{len(chunks)} journaled commits, "
+                f"{min(secs) * 1e3:.0f}–{max(secs) * 1e3:.0f} ms "
+                f"(mean {sum(secs) / len(secs) * 1e3:.0f} ms)",
+            )
+    if quality is not None and quality.get("enabled", True) and (
+        quality.get("rows_total") is not None
+    ):
+        rep.kv(
+            "cohort quality",
+            f"{quality.get('status')} over {quality.get('rows_total')} "
+            f"scored rows (score PSI {_fmt(quality.get('score_psi'), 4)})",
+        )
+        worst = (quality.get("features") or [{}])[0]
+        if worst.get("name"):
+            rep.kv(
+                "worst feature",
+                f"{worst['name']} PSI {_fmt(worst.get('psi'), 4)}",
+            )
+    if score_bench is not None:
+        rep.lines.append("")
+        rows = []
+        for leg in ("sequential", "overlapped"):
+            cell = score_bench.get(leg) or {}
+            stage = cell.get("stage_seconds") or {}
+            rows.append((
+                leg, cell.get("rows"), cell.get("rows_per_second"),
+                _fmt(cell.get("wall_seconds"), 1),
+                ", ".join(f"{k} {v}" for k, v in stage.items()) or "-",
+            ))
+        rep.table(
+            ("mode", "rows", "rows/s", "wall s", "stage busy seconds"),
+            rows,
+        )
+        rep.kv("overlap speedup", f"{score_bench.get('overlap_speedup')}x")
+        rep.kv(
+            "outputs identical",
+            score_bench.get("outputs_identical"),
+        )
+        resume = score_bench.get("resume")
+        if resume:
+            rep.kv(
+                "kill+resume",
+                f"SIGKILL after {resume.get('killed_after_chunks')} chunks "
+                f"→ resumed at {resume.get('resumed_chunks')} → output "
+                + ("byte-identical"
+                   if resume.get("identical_to_uninterrupted")
+                   else "DIFFERS"),
+            )
+        digest = (score_bench.get("manifest") or {}).get("run_id")
+        if digest:
+            rep.kv("bench manifest run id", digest)
+
+
 def _phase_summary(trace: dict) -> str:
     phases = trace.get("phases") or {}
     parts = []
@@ -394,12 +495,21 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", help="saved /debug/requests snapshot")
     ap.add_argument("--quality", help="saved /debug/quality snapshot")
     ap.add_argument("--bench", help="loadgen SERVE_BENCH_*.json artifact")
+    ap.add_argument(
+        "--score", action="store_true",
+        help="render the 'Bulk scoring' section (joins the score journal, "
+        "the cohort quality.json via --quality, and --score-bench)",
+    )
+    ap.add_argument(
+        "--score-bench", help="tools/score_bench.py SCORE_BENCH_*.json "
+        "artifact",
+    )
     ap.add_argument("--tail", type=int, default=10,
                     help="slowest sampled traces to show")
     ap.add_argument("--out", help="report path (default: stdout)")
     args = ap.parse_args(argv)
     if not (args.url or args.journal or args.metrics or args.requests
-            or args.quality):
+            or args.quality or args.score_bench):
         ap.error("nothing to report on: give --url and/or input files")
 
     health = metrics = requests = quality = None
@@ -425,18 +535,27 @@ def main(argv=None) -> int:
         _read_journal(args.journal) if args.journal else (None, [])
     )
     bench = _load_json(args.bench) if args.bench else None
+    score_bench = _load_json(args.score_bench) if args.score_bench else None
 
     rep = Report()
     _section_run(rep, manifest, health)
-    _section_traffic(rep, metrics)
-    _section_runtime(rep, (metrics or {}).get("runtime"))
-    slos = (requests or {}).get("slo")
-    _section_slo(rep, slos)
-    _section_quality(rep, quality, events, bench)
-    _section_tail(rep, requests, n=args.tail)
-    if args.journal:
-        _section_journal(rep, events)
-    _section_join(rep, bench, requests)
+    if args.score or score_bench is not None:
+        # Bulk-scoring runs have no serving traffic/SLO story: the score
+        # section replaces them, reusing --journal and --quality (pointed
+        # at the run's quality.json).
+        _section_score(rep, events, quality, score_bench)
+        if args.journal:
+            _section_journal(rep, events)
+    else:
+        _section_traffic(rep, metrics)
+        _section_runtime(rep, (metrics or {}).get("runtime"))
+        slos = (requests or {}).get("slo")
+        _section_slo(rep, slos)
+        _section_quality(rep, quality, events, bench)
+        _section_tail(rep, requests, n=args.tail)
+        if args.journal:
+            _section_journal(rep, events)
+        _section_join(rep, bench, requests)
 
     text = rep.text()
     if args.out:

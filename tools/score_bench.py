@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Population-scale bulk-scoring bench — writes a SCORE_BENCH_*.json artifact.
+
+Measures the `cli score` workload end-to-end (ingest included — the number
+request-serving benches cannot produce) on a synthetic JSONL cohort:
+
+  1. **generate** a patient cohort (``data.synthetic.make_cohort`` →
+     17-variable contract dicts, the ``loadgen --patients`` format),
+     unless ``--cohort`` reuses one;
+  2. run ``cli score --sequential`` — the ablation: read → parse →
+     device → write strictly serialized;
+  3. run the overlapped pipeline (reader + parse workers + double-
+     buffered device stage + ordered writer) on the same input;
+  4. assert the two outputs are byte-identical (overlap must be a pure
+     optimization) and record rows/s + the per-stage busy-seconds split
+     from each run's ``summary.json``;
+  5. optionally (``--resume-check``) SIGKILL an overlapped run partway
+     through — a real kill -9, not a simulated exception — rerun it to
+     completion, and assert the resumed output's sha256 equals the
+     uninterrupted run's.
+
+Every `cli score` invocation is a fresh subprocess (cold jax, honest
+end-to-end wall clock) with ``--journal``; the artifact embeds each run's
+manifest digest so the BENCH.md cell names exactly what produced it.
+
+Run from the repo root::
+
+    JAX_PLATFORMS=cpu python tools/score_bench.py --model /path/to/ckpt \\
+        --rows 1000000 --resume-check --out SCORE_BENCH_r13_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def say(msg: str) -> None:
+    print(f"[score_bench] {msg}", file=sys.stderr, flush=True)
+
+
+def generate_cohort(path: str, rows: int, seed: int) -> float:
+    """Write ``rows`` patient dicts as JSONL; returns generation seconds."""
+    import numpy as np  # noqa: F401 — make_cohort's dependency
+
+    from machine_learning_replications_tpu.data import make_cohort
+    from machine_learning_replications_tpu.data.schema import (
+        SELECTED_17,
+        selected_indices,
+    )
+
+    t0 = time.perf_counter()
+    say(f"generating {rows}-row cohort -> {path}")
+    X64, _, _ = make_cohort(n=rows, seed=seed, missing_rate=0.0)
+    C = X64[:, selected_indices()]
+    with open(path, "w") as f:
+        for row in C:
+            f.write(json.dumps(
+                {k: float(v) for k, v in zip(SELECTED_17, row)}
+            ) + "\n")
+    dt = time.perf_counter() - t0
+    say(f"cohort generated in {dt:.1f}s "
+        f"({os.path.getsize(path) / 1e6:.1f} MB)")
+    return dt
+
+
+def score_cmd(args, out_dir: str, sequential: bool) -> list[str]:
+    cmd = [
+        sys.executable, "-m", "machine_learning_replications_tpu", "score",
+        "--cohort", args.cohort, "--out", out_dir,
+        "--chunk-rows", str(args.chunk_rows),
+        "--parse-workers", str(args.parse_workers),
+        "--parse-procs", str(args.parse_procs),
+        "--prefetch", str(args.prefetch),
+        "--journal", os.path.join(out_dir, "journal.jsonl"),
+    ]
+    if args.model:
+        cmd += ["--model", args.model]
+    if args.pkl:
+        cmd += ["--pkl", args.pkl]
+    if sequential:
+        cmd += ["--sequential"]
+    if args.no_quality:
+        cmd += ["--no-quality"]
+    return cmd
+
+
+def run_score(args, out_dir: str, sequential: bool) -> dict:
+    """One leg, best-of-``--repeats`` (the BENCH.md convention: this
+    sandbox class sees ~0.5 s co-tenant stalls, and a single 1M-row wall
+    clock can swing ±25%): each repeat is a fresh subprocess into a fresh
+    directory; the best pipeline wall is the quoted cell, every repeat's
+    rows/s is recorded as the range."""
+    label = "sequential" if sequential else "overlapped"
+    best, rates = None, []
+    for rep in range(max(1, args.repeats)):
+        rep_dir = out_dir if args.repeats <= 1 else f"{out_dir}_r{rep}"
+        os.makedirs(rep_dir, exist_ok=True)
+        say(f"{label} run {rep + 1}/{args.repeats} -> {rep_dir}")
+        t0 = time.perf_counter()
+        subprocess.run(
+            score_cmd(args, rep_dir, sequential), check=True,
+            stdout=subprocess.DEVNULL,
+        )
+        wall = time.perf_counter() - t0
+        with open(os.path.join(rep_dir, "summary.json")) as f:
+            summary = json.load(f)
+        say(
+            f"{label}: {summary['rows']} rows at "
+            f"{summary['rows_per_second']} rows/s (pipeline wall "
+            f"{summary['wall_seconds']}s, process wall {wall:.1f}s incl. "
+            "jax start)"
+        )
+        rates.append(summary["rows_per_second"])
+        cell = {
+            "rows": summary["rows"],
+            "chunks": summary["chunks"],
+            "bad_rows": summary["bad_rows"],
+            "wall_seconds": summary["wall_seconds"],
+            "process_wall_seconds": round(wall, 3),
+            "rows_per_second": summary["rows_per_second"],
+            "stage_seconds": summary["stage_seconds"],
+            "output_sha256": summary["output_sha256"],
+            "jax_compiles": summary.get("jax_compiles"),
+            "run_id": (summary.get("manifest") or {}).get("run_id"),
+            "config_hash": (summary.get("manifest") or {}).get("config_hash"),
+        }
+        if best is None or cell["wall_seconds"] < best["wall_seconds"]:
+            best = cell
+    best["rows_per_second_runs"] = rates
+    return best
+
+
+def resume_check(args, golden_sha: str, workdir: str) -> dict:
+    """Kill -9 an overlapped run partway, resume it, compare output."""
+    out_dir = os.path.join(workdir, "resume")
+    os.makedirs(out_dir, exist_ok=True)
+    progress_path = os.path.join(out_dir, "progress.json")
+    say("resume check: starting run to be killed")
+    proc = subprocess.Popen(
+        score_cmd(args, out_dir, sequential=False),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    # Kill once real progress is committed (≥ 2 chunks) — mid-stream, not
+    # at the edges.
+    killed_after = None
+    t0 = time.perf_counter()
+    while proc.poll() is None:
+        time.sleep(0.25)
+        try:
+            with open(progress_path) as f:
+                chunks = json.load(f).get("chunks", 0)
+        except (OSError, json.JSONDecodeError):
+            chunks = 0
+        if chunks >= max(2, args.kill_after_chunks):
+            proc.send_signal(signal.SIGKILL)
+            killed_after = chunks
+            break
+    proc.wait()
+    if killed_after is None:
+        return {"ok": False, "error": "run finished before the kill fired"}
+    say(f"killed (SIGKILL) after ~{killed_after} committed chunks "
+        f"({time.perf_counter() - t0:.1f}s in); resuming")
+    subprocess.run(
+        score_cmd(args, out_dir, sequential=False), check=True,
+        stdout=subprocess.DEVNULL,
+    )
+    with open(os.path.join(out_dir, "summary.json")) as f:
+        summary = json.load(f)
+    identical = summary["output_sha256"] == golden_sha
+    say(f"resumed at chunk {summary['resumed_chunks']}; output "
+        + ("IDENTICAL to uninterrupted run" if identical else "DIFFERS"))
+    return {
+        "ok": identical,
+        "killed_after_chunks": killed_after,
+        "resumed_chunks": summary["resumed_chunks"],
+        "resumed_rows": summary["resumed_rows"],
+        "rows": summary["rows"],
+        "output_sha256": summary["output_sha256"],
+        "identical_to_uninterrupted": identical,
+    }
+
+
+def shard_sha256(out_dir: str) -> str:
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(out_dir)):
+        if name.startswith("scores-") and name.endswith(".jsonl"):
+            with open(os.path.join(out_dir, name), "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--model", help="Orbax checkpoint dir")
+    ap.add_argument("--pkl", help="legacy sklearn pickle")
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--seed", type=int, default=2020)
+    ap.add_argument(
+        "--cohort", default=None,
+        help="existing JSONL cohort (skips generation)",
+    )
+    ap.add_argument("--chunk-rows", type=int, default=2048)
+    ap.add_argument("--parse-workers", type=int, default=2)
+    ap.add_argument("--parse-procs", type=int, default=0)
+    ap.add_argument("--prefetch", type=int, default=4)
+    ap.add_argument(
+        "--no-quality", action="store_true",
+        help="skip the cohort quality monitor in the timed runs",
+    )
+    ap.add_argument(
+        "--resume-check", action="store_true",
+        help="also run the SIGKILL + resume verification leg",
+    )
+    ap.add_argument("--kill-after-chunks", type=int, default=2)
+    ap.add_argument(
+        "--repeats", type=int, default=1,
+        help="repeats per timed leg; the best wall is quoted, all rows/s "
+        "recorded (best-of-N, the BENCH.md noise convention)",
+    )
+    ap.add_argument(
+        "--workdir", default="score_bench_work",
+        help="scratch dir for cohort + run outputs",
+    )
+    ap.add_argument("--out", default=None, help="artifact path (JSON)")
+    args = ap.parse_args(argv)
+
+    from machine_learning_replications_tpu.obs.journal import run_manifest
+
+    os.makedirs(args.workdir, exist_ok=True)
+    gen_seconds = None
+    if args.cohort is None:
+        args.cohort = os.path.join(args.workdir, f"cohort_{args.rows}.jsonl")
+        if os.path.exists(args.cohort):
+            say(f"reusing cohort {args.cohort}")
+        else:
+            gen_seconds = generate_cohort(args.cohort, args.rows, args.seed)
+
+    seq = run_score(args, os.path.join(args.workdir, "seq"), sequential=True)
+    ovl = run_score(args, os.path.join(args.workdir, "ovl"), sequential=False)
+    outputs_identical = seq["output_sha256"] == ovl["output_sha256"]
+    speedup = (
+        round(seq["wall_seconds"] / ovl["wall_seconds"], 2)
+        if ovl["wall_seconds"] else None
+    )
+    say(f"overlap speedup: {speedup}x "
+        f"({seq['rows_per_second']} -> {ovl['rows_per_second']} rows/s); "
+        f"outputs {'identical' if outputs_identical else 'DIFFER'}")
+
+    resume = None
+    if args.resume_check:
+        resume = resume_check(args, ovl["output_sha256"], args.workdir)
+
+    artifact = {
+        "kind": "score_bench",
+        "rows": seq["rows"],
+        "chunk_rows": args.chunk_rows,
+        "parse_workers": args.parse_workers,
+        "prefetch": args.prefetch,
+        "quality": not args.no_quality,
+        "cohort": os.path.abspath(args.cohort),
+        "cohort_bytes": os.path.getsize(args.cohort),
+        "generate_seconds": (
+            round(gen_seconds, 1) if gen_seconds is not None else None
+        ),
+        "sequential": seq,
+        "overlapped": ovl,
+        "overlap_speedup": speedup,
+        "outputs_identical": outputs_identical,
+        "resume": resume,
+        "manifest": run_manifest(command="score_bench"),
+    }
+    line = json.dumps(artifact, indent=1)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        say(f"artifact written to {args.out}")
+    ok = outputs_identical and (resume is None or resume.get("ok"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
