@@ -318,6 +318,16 @@ def cmd_serve(args) -> int:
                 "alone)",
                 file=sys.stderr,
             )
+    if args.workers > 1 and args.admin_endpoint:
+        # A deploy POST through the shared SO_REUSEPORT port would land
+        # on ONE worker and leave the others on the old version — a
+        # silently mixed-version replica. Until the parent fans deploys
+        # out to every worker, multi-worker replicas deploy by restart.
+        raise SystemExit(
+            "--admin-endpoint is incompatible with --workers N: an "
+            "in-place deploy would reach only one SO_REUSEPORT worker; "
+            "deploy multi-worker replicas by rolling restart instead"
+        )
     if args.workers > 1 and worker_id is None:
         return _run_multiworker(args)
     buckets = tuple(int(b) for b in args.buckets.split(","))
@@ -355,6 +365,9 @@ def cmd_serve(args) -> int:
         "max_connections": args.max_connections,
         "host_path": not args.no_host_path,
         "host_workers": args.host_workers,
+        "replica_id": args.replica_id,
+        "register": args.register,
+        "admin_endpoint": args.admin_endpoint,
         # The thread count actually applied (None: left to XLA/operator)
         # — the bench-reproducibility knob r11 flagged, journaled so an
         # artifact can state the pool it ran under.
@@ -486,6 +499,15 @@ def _run_serve(args, buckets) -> int:
     from machine_learning_replications_tpu.persist import load_inference_params
 
     params = load_inference_params(model=args.model, pkl=args.pkl)
+    # Fleet identity (docs/FLEET.md): the checkpoint's monotonic version
+    # id rides every reply as X-Model-Version; a pickle-imported model is
+    # simply unversioned.
+    model_version = None
+    if args.model:
+        from machine_learning_replications_tpu.persist import orbax_io
+
+        model_version = orbax_io.checkpoint_version(args.model)
+    replica_id = args.replica_id
     handle = make_server(
         params,
         host=args.host,
@@ -528,6 +550,9 @@ def _run_serve(args, buckets) -> int:
         # p50, bursts coalesce into device micro-batches.
         host_path=not args.no_host_path,
         host_workers=args.host_workers,
+        model_version=model_version,
+        replica_id=replica_id,
+        admin_endpoint=args.admin_endpoint,
     )
     # Serving-process GC hygiene (the Instagram pre-fork trick): the
     # warm startup heap — jax, XLA executables, the uploaded ensemble —
@@ -540,6 +565,13 @@ def _run_serve(args, buckets) -> int:
     gc.freeze()
 
     host, port = handle.address
+    if replica_id is None and (args.register or args.advertise):
+        # Default id from the BOUND address, not args.port: with
+        # --port 0 (ephemeral) every replica would otherwise register
+        # as HOST:0 — same id, different urls — and each one's
+        # heartbeat would replace the other in the registry forever.
+        replica_id = f"{host}:{port}"
+        handle.replica_id = replica_id
     wid = getattr(args, "_worker_id", None)
     print(
         f"serving {type(params).__name__} on http://{host}:{port} "
@@ -549,6 +581,64 @@ def _run_serve(args, buckets) -> int:
         + ")",
         file=sys.stderr,
     )
+
+    # Fleet registration: announce this replica to the front-door router
+    # (fleet.router POST /fleet/replicas) on a background thread that
+    # retries until the router answers — replicas and router may start in
+    # any order. Multi-worker serve registers once (worker 0): the
+    # SO_REUSEPORT workers share one port and are one logical replica.
+    advertise = args.advertise or f"http://{host}:{port}"
+    if args.register and getattr(args, "_worker_id", None) in (None, 0):
+        import threading
+        import time
+        import urllib.request
+
+        register_url = args.register.rstrip("/") + "/fleet/replicas"
+
+        def _register_loop():
+            # A heartbeat, not a one-shot: registration is idempotent
+            # (same id + url keeps the router's rotation state), so
+            # re-posting every beat means a RESTARTED router — whose
+            # in-memory registry came up empty — repopulates within one
+            # interval instead of serving "no ready replicas" until
+            # every replica is manually bounced.
+            body = json.dumps(
+                {"id": replica_id, "url": advertise}
+            ).encode()
+            registered = False
+            while not handle.draining:
+                try:
+                    urllib.request.urlopen(
+                        urllib.request.Request(
+                            register_url, data=body,
+                            headers={"Content-Type": "application/json"},
+                        ),
+                        timeout=5,
+                    ).read()
+                except Exception:
+                    registered = False
+                    time.sleep(1.0)
+                    continue
+                if not registered:
+                    registered = True
+                    from machine_learning_replications_tpu.obs import (
+                        journal,
+                    )
+
+                    journal.event(
+                        "replica_registered", router=args.register,
+                        replica=replica_id, url=advertise,
+                    )
+                    print(
+                        f"registered with router {args.register} as "
+                        f"{replica_id!r} ({advertise})",
+                        file=sys.stderr,
+                    )
+                time.sleep(10.0)
+
+        threading.Thread(
+            target=_register_loop, name="serve-register", daemon=True
+        ).start()
 
     def _graceful(signum, frame):
         print("draining and shutting down ...", file=sys.stderr)
@@ -564,6 +654,24 @@ def _run_serve(args, buckets) -> int:
         handle.serve_forever()
     finally:
         handle.shutdown()
+        if args.register and getattr(args, "_worker_id", None) in (None, 0):
+            # Best-effort deregistration: a drained replica should leave
+            # the rotation table instead of waiting out probe failures.
+            import urllib.request
+
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        args.register.rstrip("/") + "/fleet/replicas",
+                        data=json.dumps(
+                            {"deregister": replica_id}
+                        ).encode(),
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=5,
+                ).read()
+            except Exception:
+                pass
     return 0
 
 
@@ -707,6 +815,159 @@ def _write_score_metrics(args) -> None:
     with open(args.metrics_out, "w") as f:
         f.write(REGISTRY.render_prometheus())
     print(f"metrics written to {args.metrics_out}", file=sys.stderr)
+
+
+def cmd_fleet(args) -> int:
+    """Fleet tier (docs/FLEET.md): front-door router, rolling deploys,
+    and fleet status — the `cli fleet ROLE` entry points. All three are
+    jax-free: a router process needs no accelerator stack."""
+    if args.role == "router":
+        return _run_fleet_router(args)
+    if args.role == "deploy":
+        return _run_fleet_deploy(args)
+    return _run_fleet_status(args)
+
+
+def _run_fleet_router(args) -> int:
+    import signal
+    import threading
+
+    from machine_learning_replications_tpu.fleet import make_router
+    from machine_learning_replications_tpu.obs import journal
+
+    replicas = []
+    for spec in args.replica or []:
+        rid, sep, url = spec.partition("=")
+        if not sep or not rid or not url:
+            raise SystemExit(
+                f"--replica expects ID=URL, got {spec!r}"
+            )
+        replicas.append((rid, url))
+    jrn = None
+    if args.journal:
+        # Deliberately not _observed: that path installs jax.monitoring
+        # accounting, and the router must stay jax-free.
+        jrn = journal.RunJournal(args.journal, command="fleet router")
+        journal.set_journal(jrn)
+    handle = make_router(
+        host=args.host,
+        port=args.port,
+        replicas=replicas,
+        request_timeout_s=args.request_timeout,
+        hedge_ms=args.hedge_ms,
+        max_attempts=args.max_attempts,
+        probe_interval_s=args.probe_interval,
+        probe_timeout_s=args.probe_timeout,
+        fail_threshold=args.fail_threshold,
+        recover_probes=args.recover_probes,
+        breaker_failures=args.breaker_failures,
+        forward_workers=args.forward_workers,
+        quiet=not args.verbose,
+    )
+    host, port = handle.address
+    print(
+        f"fleet router on http://{host}:{port} "
+        f"({len(replicas)} static replicas; POST /fleet/replicas to "
+        "register more)",
+        file=sys.stderr,
+    )
+
+    def _graceful(signum, frame):
+        print("router shutting down ...", file=sys.stderr)
+        threading.Thread(target=handle.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        handle.serve_forever()
+    finally:
+        handle.shutdown()
+        if jrn is not None:
+            journal.set_journal(None)
+            jrn.close()
+            print(f"journal written to {jrn.path}", file=sys.stderr)
+    return 0
+
+
+def _run_fleet_deploy(args) -> int:
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        args.router.rstrip("/") + "/fleet/deploy",
+        data=json.dumps({"model": args.model}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            report = json.loads(resp.read())["deploy"]
+    except urllib.error.HTTPError as exc:
+        body = exc.read()
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            payload = None
+        if not isinstance(payload, dict):
+            raise SystemExit(
+                f"deploy request failed (http {exc.code}): "
+                f"{body[:200]!r}"
+            )
+        if exc.code == 409:
+            # Single-flight refusal: the "deploy" in this body is the
+            # OTHER rollout's live status (result "ok" from the moment
+            # it starts) — treating it as ours would print success for
+            # a deploy that never began.
+            raise SystemExit(
+                "deploy refused: a rolling deploy is already in "
+                "progress — watch it with `fleet status`:\n"
+                + json.dumps(payload.get("deploy"), indent=1)
+            )
+        report = payload.get("deploy")
+        if not isinstance(report, dict):
+            raise SystemExit(
+                f"deploy request failed (http {exc.code}): "
+                f"{body[:200]!r}"
+            )
+    except (urllib.error.URLError, OSError) as exc:
+        # Unreachable router / reset / client-side timeout: a clean exit
+        # beats a traceback. NOTE a timed-out POST does not stop the
+        # rollout server-side — `fleet status` shows where it got to.
+        raise SystemExit(
+            f"deploy request to {args.router} failed: {exc} "
+            "(the rollout may still be running; check `fleet status`)"
+        )
+    print(json.dumps(report, indent=1))
+    if report.get("result") != "ok":
+        print(
+            f"rollout {report.get('result')}: "
+            f"{report.get('error', 'no detail')}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"rollout ok: version {report.get('target_version')} on "
+        f"{len(report.get('replicas', []))} replicas",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _run_fleet_status(args) -> int:
+    import urllib.error
+    import urllib.request
+
+    base = args.router.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        with urllib.request.urlopen(
+            base + "/fleet/replicas", timeout=10
+        ) as resp:
+            replicas = json.loads(resp.read())["replicas"]
+    except (urllib.error.URLError, OSError) as exc:
+        raise SystemExit(f"fleet status request to {args.router} failed: {exc}")
+    print(json.dumps({"router": health, "replicas": replicas}, indent=1))
+    return 0
 
 
 def cmd_sweep(args) -> int:
@@ -1006,9 +1267,121 @@ def build_parser() -> argparse.ArgumentParser:
         "alone; ignored when XLA_FLAGS already sets the knobs). The "
         "applied value is journaled in the serve manifest",
     )
+    v.add_argument(
+        "--replica-id", default=None,
+        help="fleet identity echoed on every reply as X-Replica and on "
+        "the health probes (default when registering: HOST:PORT; "
+        "docs/FLEET.md)",
+    )
+    v.add_argument(
+        "--register", default=None, metavar="ROUTER_URL",
+        help="self-register with a fleet router (POST /fleet/replicas), "
+        "retrying until it answers; deregisters on graceful shutdown. "
+        "With --workers N only worker 0 registers (one shared port = "
+        "one logical replica)",
+    )
+    v.add_argument(
+        "--advertise", default=None, metavar="URL",
+        help="the URL the router should reach this replica at (default "
+        "http://HOST:PORT — override when behind NAT or a hostname)",
+    )
+    v.add_argument(
+        "--admin-endpoint", action="store_true",
+        help="enable the guarded /admin/deploy warm-swap endpoint "
+        "(rolling deploys, docs/FLEET.md); off by default for the same "
+        "reason /debug/faults is",
+    )
     v.add_argument("--verbose", action="store_true", help="log each request")
     add_obs_flags(v)
     v.set_defaults(fn=cmd_serve)
+
+    f = sub.add_parser(
+        "fleet",
+        help="fleet tier: front-door router, rolling deploys, status "
+        "(docs/FLEET.md)",
+    )
+    fsub = f.add_subparsers(dest="role", required=True)
+    fr = fsub.add_parser(
+        "router",
+        help="run the front-door router: replica registry, /readyz-driven "
+        "rotation, retry/hedging, /fleet control plane",
+    )
+    fr.add_argument("--host", default="127.0.0.1")
+    fr.add_argument("--port", type=int, default=8080)
+    fr.add_argument(
+        "--replica", action="append", metavar="ID=URL", default=None,
+        help="seed the registry with a static replica (repeatable); "
+        "replicas may also self-register via `cli serve --register`",
+    )
+    fr.add_argument(
+        "--request-timeout", type=float, default=30.0,
+        help="router-side reply deadline per request (seconds); an "
+        "inbound X-Request-Deadline-Ms tightens it, never loosens",
+    )
+    fr.add_argument(
+        "--hedge-ms", type=float, default=250.0,
+        help="fire a duplicate attempt against a second replica when the "
+        "first has not answered within this delay (0 disables hedging)",
+    )
+    fr.add_argument(
+        "--max-attempts", type=int, default=3,
+        help="upstream attempts per request (first + retries/hedges)",
+    )
+    fr.add_argument(
+        "--probe-interval", type=float, default=0.5,
+        help="seconds between /readyz probe passes",
+    )
+    fr.add_argument(
+        "--probe-timeout", type=float, default=2.0,
+        help="per-probe HTTP timeout",
+    )
+    fr.add_argument(
+        "--fail-threshold", type=int, default=2,
+        help="consecutive failed probes before rotation out (an explicit "
+        "not-ready rotates out on the first probe)",
+    )
+    fr.add_argument(
+        "--recover-probes", type=int, default=2,
+        help="consecutive ready probes before an out replica re-enters "
+        "rotation",
+    )
+    fr.add_argument(
+        "--breaker-failures", type=int, default=3,
+        help="consecutive request failures that open a replica's breaker "
+        "(immediate rotation out; probes close it)",
+    )
+    fr.add_argument(
+        "--forward-workers", type=int, default=8,
+        help="upstream forwarder threads (each keeps one keep-alive "
+        "connection per replica)",
+    )
+    fr.add_argument(
+        "--journal", default=None,
+        help="JSONL journal path (registration, rotation, deploy arc)",
+    )
+    fr.add_argument("--verbose", action="store_true")
+    fr.set_defaults(fn=cmd_fleet)
+    fd = fsub.add_parser(
+        "deploy",
+        help="rolling deploy: drive a new checkpoint version across the "
+        "fleet through the router, one replica at a time",
+    )
+    fd.add_argument("--router", required=True, help="router base URL")
+    fd.add_argument(
+        "--model", required=True,
+        help="checkpoint directory (every replica must be able to read "
+        "this path)",
+    )
+    fd.add_argument(
+        "--timeout", type=float, default=1800.0,
+        help="end-to-end rollout timeout (seconds)",
+    )
+    fd.set_defaults(fn=cmd_fleet)
+    fs = fsub.add_parser(
+        "status", help="print the router's registry and health snapshot"
+    )
+    fs.add_argument("--router", required=True, help="router base URL")
+    fs.set_defaults(fn=cmd_fleet)
 
     c = sub.add_parser(
         "score",

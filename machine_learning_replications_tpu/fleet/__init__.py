@@ -1,0 +1,50 @@
+"""L7 — the fleet tier: N replicas as one service (docs/FLEET.md).
+
+Everything below this package is replica-side plumbing — the
+liveness/readiness split, drain-first shutdown, breaker-aware
+``/readyz``, last-known-good rollback, the event-loop transport. The
+fleet tier is the layer that composes them into a *service*:
+
+  * ``fleet.registry`` — the replica rotation table: probe-driven
+    in/out, per-replica request breakers, admin holds, every transition
+    journaled and on ``fleet_*`` metrics.
+  * ``fleet.health`` — the ``/readyz`` prober feeding the registry.
+  * ``fleet.router`` — the front-door HTTP router (``make_router``):
+    the serve transport reused, with per-request retry/hedging, deadline
+    propagation, and replica/version header passthrough.
+  * ``fleet.deploy`` — rolling deploys of versioned checkpoints
+    (``persist.checkpoint_version``), one replica at a time through the
+    replica-side ``/admin/deploy`` warm swap, with the last-known-good
+    rollback as the safety net.
+
+Deliberately jax-free: a router process starts in milliseconds and
+needs no accelerator stack.
+"""
+
+from machine_learning_replications_tpu.fleet.deploy import (
+    manifest_version,
+    rolling_deploy,
+)
+from machine_learning_replications_tpu.fleet.health import (
+    HealthProber,
+    probe_replica,
+)
+from machine_learning_replications_tpu.fleet.registry import (
+    Replica,
+    ReplicaRegistry,
+)
+from machine_learning_replications_tpu.fleet.router import (
+    RouterHandle,
+    make_router,
+)
+
+__all__ = [
+    "HealthProber",
+    "Replica",
+    "ReplicaRegistry",
+    "RouterHandle",
+    "make_router",
+    "manifest_version",
+    "probe_replica",
+    "rolling_deploy",
+]

@@ -1,0 +1,205 @@
+"""Rolling deploys: a new checkpoint version across the fleet, one
+replica at a time, with zero client-visible downtime.
+
+The lifecycle per replica (docs/FLEET.md "Deploy lifecycle"):
+
+  1. **Capacity gate.** Refuse to touch a replica unless at least one
+     OTHER replica is in rotation (waiting up to ``capacity_timeout_s``
+     for one to appear) — a rollout must never take the last server out
+     from under live traffic.
+  2. **Hold.** ``registry.hold`` removes the replica from routing while
+     it keeps serving its in-flight work; new traffic flows to the rest
+     of the fleet.
+  3. **Warm swap.** One long ``POST /admin/deploy`` to the replica
+     (``serve.server`` — load with integrity verification and the
+     last-known-good rollback net, build + warm the new engine off the
+     request path, parity-probe, atomic swap). The reply carries the
+     achieved version and whether the restore rolled back.
+  4. **Verify + release.** Poll the replica's ``/readyz`` until it
+     reports ready AT the achieved version, release the hold, and wait
+     for the registry (probe-fed) to rotate it back in before moving on.
+
+A replica that reports ``rolled_back`` (corrupt target checkpoint → it
+restored the retained last-known-good) or a version other than the
+rollout target **stops the rollout**: the remaining replicas keep the
+old version, the report says ``rolled_back``, and the journal carries
+the full arc (``fleet_deploy_start`` → per-replica
+``fleet_deploy_replica`` → ``fleet_deploy_done``). A replica whose swap
+fails outright keeps its previous engine (the replica-side contract)
+and the rollout stops with ``result="failed"`` — in every case the
+fleet is left serving *some* consistent, parity-verified version.
+
+The rollout's target version is read from the checkpoint's
+``integrity.json`` when the controller can see the path (a local JSON
+read — deliberately NOT ``persist.orbax_io``, which imports jax and
+orbax; the router process stays accelerator-free); on a router without
+filesystem access to the checkpoint, the first replica's achieved
+version becomes the target the rest must match.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+from machine_learning_replications_tpu.obs import journal
+
+
+def manifest_version(path: str | os.PathLike) -> int | None:
+    """The monotonic version id in a checkpoint's ``integrity.json`` —
+    the jax-free mirror of ``persist.checkpoint_version`` for the
+    router process. None when unreadable or unversioned."""
+    try:
+        with open(os.path.join(os.fspath(path), "integrity.json")) as f:
+            v = json.load(f).get("version")
+        return int(v) if v is not None else None
+    except (OSError, ValueError, json.JSONDecodeError, TypeError):
+        return None
+
+
+def _post_admin_deploy(url: str, model: str, timeout_s: float) -> dict:
+    """The replica-side warm swap; returns its final deploy status dict.
+    Raises ``RuntimeError`` with the replica's error on failure."""
+    req = urllib.request.Request(
+        url.rstrip("/") + "/admin/deploy",
+        data=json.dumps({"model": model}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read())["deploy"]
+    except urllib.error.HTTPError as exc:
+        try:
+            body = json.loads(exc.read() or b"{}")
+        except (ValueError, OSError):
+            body = {}
+        raise RuntimeError(
+            f"replica deploy failed (http {exc.code}): "
+            f"{body.get('error', 'no detail')}"
+        ) from exc
+
+
+def _wait(pred, timeout_s: float, what: str, poll_s: float = 0.1) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll_s)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def rolling_deploy(
+    registry,
+    model_path: str,
+    admin_timeout_s: float = 600.0,
+    ready_timeout_s: float = 60.0,
+    capacity_timeout_s: float = 30.0,
+    status_cb=None,
+) -> dict:
+    """Drive the checkpoint at ``model_path`` across every registered
+    replica (see module docstring). Returns the rollout report; never
+    raises for per-replica failures — the report's ``result`` is
+    ``ok`` / ``rolled_back`` / ``failed``."""
+    from machine_learning_replications_tpu.fleet.health import probe_replica
+
+    target = manifest_version(model_path)
+    report: dict = {
+        "kind": "fleet_deploy",
+        "model": model_path,
+        "target_version": target,
+        "replicas": [],
+        "result": "ok",
+        "started": time.time(),
+    }
+
+    def publish(state: str) -> None:
+        report["state"] = state
+        if status_cb is not None:
+            status_cb(dict(report))
+
+    members = registry.snapshot()
+    journal.event(
+        "fleet_deploy_start", model=model_path, target_version=target,
+        replicas=[r["id"] for r in members],
+    )
+    publish("running")
+    for member in members:
+        rid, url = member["id"], member["url"]
+        step: dict = {"replica": rid, "result": "ok"}
+        report["replicas"].append(step)
+        try:
+            if registry.get(rid) is None:
+                step.update(result="skipped", error="deregistered mid-rollout")
+                continue
+            # 1. Capacity gate: someone ELSE must be carrying traffic.
+            _wait(
+                lambda: any(
+                    r["in_rotation"] for r in registry.snapshot()
+                    if r["id"] != rid
+                ),
+                capacity_timeout_s,
+                f"another in-rotation replica before deploying {rid!r}",
+            )
+            # 2. Hold: out of routing, still serving in-flight work.
+            registry.hold(rid)
+            publish(f"deploying {rid}")
+            # 3. The replica-side warm swap (load → warm → parity → swap).
+            status = _post_admin_deploy(url, model_path, admin_timeout_s)
+            achieved = status.get("version")
+            rolled_back = bool(status.get("rolled_back"))
+            step.update(
+                achieved_version=achieved, rolled_back=rolled_back,
+                seconds=status.get("seconds"),
+            )
+            # 4. Ready at the achieved version, then back into rotation.
+            _wait(
+                lambda: (
+                    lambda p: p["ok"] and p["ready"]
+                    and p["version"] == achieved
+                )(probe_replica(url)),
+                ready_timeout_s,
+                f"{rid!r} ready at version {achieved}",
+            )
+            registry.release(rid)
+            _wait(
+                lambda: (registry.get(rid) or {}).get("in_rotation"),
+                ready_timeout_s, f"{rid!r} back in rotation",
+            )
+            if target is None:
+                # No filesystem view of the checkpoint: the first
+                # replica's achieved version defines the rollout target.
+                target = report["target_version"] = achieved
+            if rolled_back or (
+                target is not None and achieved != target
+            ):
+                step["result"] = "rolled_back"
+                report["result"] = "rolled_back"
+                report["error"] = (
+                    f"replica {rid!r} restored version {achieved} instead "
+                    f"of the target {target} "
+                    "(corrupt checkpoint rolled back to last-known-good); "
+                    "rollout stopped"
+                )
+        except Exception as exc:
+            registry.release(rid)
+            step.update(
+                result="failed", error=f"{type(exc).__name__}: {exc}"
+            )
+            report["result"] = "failed"
+            report["error"] = step["error"]
+        finally:
+            journal.event("fleet_deploy_replica", model=model_path, **step)
+        if report["result"] != "ok":
+            break  # leave the rest of the fleet on the known-good version
+    report["seconds"] = round(time.time() - report["started"], 3)
+    journal.event(
+        "fleet_deploy_done", model=model_path,
+        target_version=report["target_version"],
+        result=report["result"], error=report.get("error"),
+        seconds=report["seconds"],
+    )
+    publish("done")
+    return report

@@ -1,0 +1,98 @@
+"""Periodic ``/readyz`` prober feeding the replica registry.
+
+The replicas already publish exactly the signal a load balancer needs
+(PR 5's liveness/readiness split): ``/readyz`` answers 200 only when the
+engine is warm, the server is not draining, and the breaker is closed —
+and since the fleet tier it also echoes the replica's id and served
+checkpoint version. This thread closes the loop: every ``interval_s`` it
+GETs each registered replica's ``/readyz`` (bounded by ``timeout_s``)
+and reports the verdict to ``ReplicaRegistry.observe_probe``, which owns
+all rotation policy. The prober itself decides nothing — it is a clock
+plus an HTTP client, so the rotation rules live (and are tested) in one
+place.
+
+Runs on its own daemon thread with plain blocking ``urllib`` — probing
+is off the router's event loop by construction, and at fleet sizes where
+sequential probing would lag the tick, the interval is the knob (or run
+several probers over disjoint registries).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+
+def probe_replica(url: str, timeout_s: float = 2.0) -> dict:
+    """One ``/readyz`` probe: ``{"ok", "ready", "version"}``. ``ok``
+    is HTTP-level success (an explicit 503 is ok=True, ready=False —
+    the replica answered, and said no); transport failures are
+    ok=False. Never raises."""
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/readyz", timeout=timeout_s
+        ) as resp:
+            body = json.loads(resp.read())
+        return {
+            "ok": True, "ready": bool(body.get("ready")),
+            "version": body.get("version"),
+        }
+    except urllib.error.HTTPError as exc:
+        try:
+            body = json.loads(exc.read() or b"{}")
+        except (ValueError, OSError):
+            body = {}
+        return {
+            "ok": True, "ready": bool(body.get("ready")),
+            "version": body.get("version"),
+        }
+    except Exception:
+        return {"ok": False, "ready": False, "version": None}
+
+
+class HealthProber:
+    """Daemon thread probing every registered replica each tick."""
+
+    def __init__(
+        self,
+        registry,
+        interval_s: float = 0.5,
+        timeout_s: float = 2.0,
+    ) -> None:
+        self.registry = registry
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-prober", daemon=True
+        )
+
+    def start(self) -> "HealthProber":
+        self._thread.start()
+        return self
+
+    def tick(self) -> None:
+        """One probe pass over the current membership (also the unit the
+        tests drive directly, without the thread)."""
+        for replica_id, url in self.registry.urls():
+            if self._stop.is_set():
+                return
+            verdict = probe_replica(url, timeout_s=self.timeout_s)
+            self.registry.observe_probe(
+                replica_id, ok=verdict["ok"], ready=verdict["ready"],
+                version=verdict["version"],
+            )
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                pass  # a probe pass must never kill the prober
+
+    def close(self, timeout: float | None = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)

@@ -1,0 +1,827 @@
+"""Front-door HTTP router: N replicas behind one address, zero-downtime.
+
+The router reuses the serving stack's proven transport — the same
+``serve.protocol`` parse rules and ``serve.transport`` non-blocking
+event loop the 1332-qps front end runs on — with a different
+application behind it: instead of an engine, a **replica registry**
+(``fleet.registry``), a **health prober** (``fleet.health``), and a
+**proxy data path** with per-request retry and hedging.
+
+Data path (``POST /predict``):
+
+  * The handler (event-loop thread) picks an in-rotation replica
+    (round-robin, per-replica breakers skipped) and hands the attempt to
+    a small forwarder thread pool — upstream I/O never blocks the loop.
+    Each forwarder keeps one persistent keep-alive connection per
+    replica (the loadgen lesson: no per-request TCP handshake on the
+    hot path), with one transparent fresh-connection resend when a
+    reused socket died idle.
+  * The client's deadline (``--request-timeout``, tightened by an
+    inbound ``X-Request-Deadline-Ms``, never loosened) rides DOWN to the
+    replica as the remaining budget and is enforced router-side by a
+    loop timer: a request is answered or 504'd in bounded time, never
+    hung — the same contract the replicas make individually.
+  * **Retry**: a transport failure or 5xx marks the replica
+    (``registry.mark_failure`` — the per-replica breaker) and re-sends
+    the request to the next replica, up to ``max_attempts`` and always
+    within the deadline. A 503 shed retries on a *different* replica
+    immediately; when only the shedding replica exists, the upstream's
+    ``Retry-After`` is honored (bounded by the remaining budget) before
+    one same-replica retry — and passed through to the client when the
+    budget cannot cover it. ``/predict`` is a pure function, so
+    re-sends and duplicates cannot double-apply anything.
+  * **Hedging** (``hedge_ms`` > 0): when the first attempt has not
+    answered within the hedge delay and a second in-rotation replica
+    exists, a duplicate fires; the first reply wins, the loser is
+    discarded. Tail latency from one slow replica costs one duplicate
+    request instead of a client-visible stall.
+  * Replies pass through the replica's body and identity headers
+    (``X-Replica`` / ``X-Model-Version`` / ``X-Serve-Path``) — the
+    rolling-deploy crossover is provable from the client side.
+
+Control plane: ``/fleet/replicas`` (GET snapshot; POST register /
+deregister — ``cli serve --register`` posts here), ``/fleet/deploy``
+(POST starts a rolling deploy through ``fleet.deploy``; GET status),
+``/healthz`` / ``/readyz`` (a router with zero in-rotation replicas is
+alive but not ready), ``/metrics`` (``fleet_*`` families through the
+process registry, strict-exposition clean), and ``/debug/requests``
+(the router's own flight-recorded traces: route → upstream → respond
+phase attribution per sampled request).
+
+No jax imports anywhere on this path — the router starts in
+milliseconds and runs fine on a host with no accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+
+from machine_learning_replications_tpu.obs import journal, reqtrace
+from machine_learning_replications_tpu.obs.registry import REGISTRY
+from machine_learning_replications_tpu.fleet.health import HealthProber
+from machine_learning_replications_tpu.fleet.registry import ReplicaRegistry
+from machine_learning_replications_tpu.serve.metrics import LATENCY_BUCKETS_S
+from machine_learning_replications_tpu.serve.transport import (
+    EventLoopHttpServer,
+)
+
+FLEET_REQUESTS = REGISTRY.counter(
+    "fleet_requests_total",
+    "Routed /predict requests by terminal outcome (ok, shed, error, "
+    "timeout, no_replica, bad_request).",
+    labels=("outcome",),
+)
+FLEET_UPSTREAM = REGISTRY.counter(
+    "fleet_upstream_attempts_total",
+    "Upstream /predict attempts by result (ok, shed, server_error, "
+    "conn_error, client_error).",
+    labels=("result",),
+)
+FLEET_RETRIES = REGISTRY.counter(
+    "fleet_retries_total",
+    "Requests re-sent to another replica, by what failed the previous "
+    "attempt.",
+    labels=("reason",),
+)
+FLEET_HEDGES = REGISTRY.counter(
+    "fleet_hedges_total",
+    "Hedged duplicate attempts fired against a second replica.",
+)
+FLEET_HEDGE_WINS = REGISTRY.counter(
+    "fleet_hedge_wins_total",
+    "Hedged duplicates that answered before the original attempt.",
+)
+FLEET_REPLICA_REQUESTS = REGISTRY.counter(
+    "fleet_replica_requests_total",
+    "Upstream attempts per replica by result.",
+    labels=("replica", "result"),
+)
+FLEET_LATENCY = REGISTRY.histogram(
+    "fleet_request_latency_seconds",
+    "Router-side /predict latency, admission to reply enqueue.",
+    LATENCY_BUCKETS_S,
+)
+FLEET_DEPLOYS = REGISTRY.counter(
+    "fleet_deploys_total",
+    "Rolling deploys driven through this router by result.",
+    labels=("result",),
+)
+for _outcome in ("ok", "shed", "error", "timeout", "no_replica"):
+    FLEET_REQUESTS.labels(outcome=_outcome)
+FLEET_HEDGES.get()
+FLEET_HEDGE_WINS.get()
+
+
+class _Forwarders:
+    """Small pool of daemon threads running upstream calls — the proxy's
+    answer to 'handlers must not block the loop'. Each thread caches one
+    persistent keep-alive connection per (replica id, url)."""
+
+    def __init__(self, workers: int = 8) -> None:
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._local = threading.local()
+        self._threads = [
+            threading.Thread(
+                target=self._loop, name=f"fleet-forward-{i}", daemon=True
+            )
+            for i in range(max(1, int(workers)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn) -> None:
+        self._q.put(fn)
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                self._q.put(None)  # let the other workers see it too
+                return
+            try:
+                fn()
+            except Exception:
+                pass  # a forwarded attempt must never kill a worker
+
+    def close(self) -> None:
+        self._q.put(None)
+
+    # -- per-thread keep-alive connections ----------------------------------
+
+    def call(
+        self, replica_id: str, url: str, method: str, path: str,
+        body: bytes | None, headers: dict[str, str], timeout_s: float,
+    ) -> tuple[int, dict[str, str], bytes]:
+        """One upstream HTTP call over this thread's cached connection to
+        the replica; a dead reused socket gets one transparent fresh
+        connection. Raises ``OSError``/``http.client`` errors on
+        transport failure (the caller classifies)."""
+        import http.client
+        import urllib.parse
+
+        cache = getattr(self._local, "conns", None)
+        if cache is None:
+            cache = self._local.conns = {}
+        key = (replica_id, url)
+        conn = cache.get(key)
+        fresh = conn is None
+        if fresh:
+            u = urllib.parse.urlparse(url)
+            conn = http.client.HTTPConnection(
+                u.hostname or "127.0.0.1", u.port or 80, timeout=timeout_s
+            )
+            cache[key] = conn
+        conn.timeout = timeout_s
+        try:
+            return self._once(conn, method, path, body, headers)
+        except (http.client.HTTPException, OSError):
+            conn.close()
+            if fresh:
+                cache.pop(key, None)
+                raise
+            # Reused socket died (idle reap, replica restart): one resend
+            # on a fresh connection before the failure becomes real.
+            try:
+                return self._once(conn, method, path, body, headers)
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                cache.pop(key, None)
+                raise
+
+    @staticmethod
+    def _once(conn, method, path, body, headers):
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        hdrs = {k.lower(): v for k, v in resp.getheaders()}
+        if hdrs.get("connection", "").lower() == "close" or resp.will_close:
+            conn.close()
+        return resp.status, hdrs, data
+
+
+_PASSTHROUGH_HEADERS = ("x-replica", "x-model-version", "x-serve-path")
+
+
+class _ProxyJob:
+    """One routed /predict request: the race between upstream attempts
+    (forwarder threads), the hedge timer, and the deadline timer (loop
+    thread) resolves under one lock — exactly one of them replies."""
+
+    __slots__ = (
+        "app", "trace", "responder", "body", "pin", "deadline_mono",
+        "deadline_s", "tried", "first_replica", "attempts", "hedged",
+        "t_route0", "deadline_timer", "hedge_timer", "_done", "_lock",
+        "last_retry_after",
+    )
+
+    def __init__(self, app, trace, responder, body: bytes,
+                 pin: str | None, deadline_s: float) -> None:
+        self.app = app
+        self.trace = trace
+        self.responder = responder
+        self.body = body
+        self.pin = pin
+        self.deadline_s = deadline_s
+        self.deadline_mono = time.monotonic() + deadline_s
+        self.tried: set[str] = set()
+        self.first_replica: str | None = None
+        self.attempts = 0
+        self.hedged = False
+        self.t_route0 = time.perf_counter()
+        self.deadline_timer = None
+        self.hedge_timer = None
+        self.last_retry_after: str | None = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def _claim(self) -> bool:
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+            return True
+
+    # -- admission / dispatch (loop thread first, then any thread) -----------
+
+    def start(self) -> None:
+        rep = self.app.registry.pick()
+        if rep is None:
+            self.finish_no_replica()
+            return
+        self.deadline_timer = self.app.httpd.call_later(
+            self.deadline_s, self.on_deadline
+        )
+        if self.app.hedge_s > 0:
+            self.hedge_timer = self.app.httpd.call_later(
+                self.app.hedge_s, self.on_hedge
+            )
+        self.dispatch(rep)
+
+    def finish_no_replica(self) -> None:
+        if not self._claim():
+            return
+        self._cancel_timers()
+        self.app.finish(
+            self, "no_replica", 503,
+            body=json.dumps({"error": "no ready replicas"}).encode(),
+            headers={"Retry-After": "1"},
+        )
+
+    def dispatch(self, rep: dict) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self.attempts += 1
+            if self.first_replica is None:
+                self.first_replica = rep["id"]
+            self.tried.add(rep["id"])
+        self.app.forwarders.submit(lambda: self.attempt(rep))
+
+    def retry(self, reason: str, failed: dict) -> bool:
+        """Pick another replica and re-send; False when the retry budget
+        (attempts, candidates, deadline) is exhausted."""
+        if self.attempts >= self.app.max_attempts:
+            return False
+        if time.monotonic() >= self.deadline_mono:
+            return False  # the deadline timer is about to answer
+        rep = self.app.registry.pick(exclude=self.tried)
+        if rep is None:
+            return False
+        FLEET_RETRIES.inc(reason=reason)
+        self.trace.note(retried=reason)
+        self.dispatch(rep)
+        return True
+
+    # -- timers (loop thread) ------------------------------------------------
+
+    def on_deadline(self) -> None:
+        if not self._claim():
+            return
+        if self.hedge_timer is not None:
+            self.hedge_timer.cancel()
+        self.app.finish(
+            self, "timeout", 504,
+            body=json.dumps({
+                "error": f"timed out after {self.deadline_s:g}s "
+                "(no replica answered in budget)",
+            }).encode(),
+        )
+
+    def on_hedge(self) -> None:
+        """Hedge delay expired with no reply: fire a duplicate against a
+        replica not yet tried (if one is in rotation). ``pick`` falls
+        back to already-tried replicas when nothing else is ready —
+        right for retries, wrong here: hedging a slow replica with a
+        duplicate to ITSELF would double the load on the one struggling
+        server, so an exhausted pool means no hedge. The hedge is an
+        upstream attempt like any other and counts against
+        ``max_attempts`` — with the cap already spent, firing one would
+        exceed the operator's per-request attempt budget exactly when
+        the fleet is slow."""
+        with self._lock:
+            if self._done or self.hedged:
+                return
+            if self.attempts >= self.app.max_attempts:
+                return
+            rep = self.app.registry.pick(exclude=self.tried)
+            if rep is None or rep["id"] in self.tried:
+                return
+            self.hedged = True
+        FLEET_HEDGES.inc()
+        self.trace.note(hedged=True)
+        self.dispatch(rep)
+
+    # -- the upstream attempt (forwarder thread) ------------------------------
+
+    def attempt(self, rep: dict) -> None:
+        if self._done:
+            return
+        remaining = self.deadline_mono - time.monotonic()
+        if remaining <= 0.005:
+            return  # the deadline timer answers
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": self.trace.request_id,
+            # The remaining budget rides down so the replica's own
+            # deadline machinery (504 + cancel-unflushed) is in play for
+            # exactly the time the client is still listening.
+            "X-Request-Deadline-Ms": str(int(remaining * 1000)),
+        }
+        if self.pin:
+            headers["X-Serve-Path"] = self.pin
+        try:
+            code, up_headers, data = self.app.forwarders.call(
+                rep["id"], rep["url"], "POST", "/predict", self.body,
+                headers, timeout_s=remaining,
+            )
+        except Exception as exc:
+            self._upstream_result(rep, "conn_error")
+            self.app.registry.mark_failure(
+                rep["id"], f"{type(exc).__name__}: {exc}"
+            )
+            if not self.retry("conn_error", rep) and self._claim():
+                self._cancel_timers()
+                self.app.finish(
+                    self, "error", 503,
+                    body=json.dumps({
+                        "error": "no replica answered "
+                        f"(last: {type(exc).__name__})",
+                    }).encode(),
+                    headers={"Retry-After": "1"}, replica=rep["id"],
+                )
+            return
+        if code == 200:
+            self._upstream_result(rep, "ok")
+            self.app.registry.mark_success(rep["id"])
+            won_hedge = self.hedged and rep["id"] != self.first_replica
+            if not self._claim():
+                return  # the other attempt (or the deadline) answered
+            if won_hedge:
+                FLEET_HEDGE_WINS.inc()
+            self._cancel_timers()
+            self.app.finish(
+                self, "ok", 200, body=data, upstream_headers=up_headers,
+                replica=rep["id"],
+            )
+            return
+        if code == 503:
+            self._upstream_result(rep, "shed")
+            self.last_retry_after = up_headers.get("retry-after")
+            # A shedding replica is HEALTHY (explicit admission control
+            # or degraded mode) — not a breaker strike; the prober
+            # rotates it out if /readyz agrees. Prefer another replica
+            # right now.
+            if self.retry("shed", rep):
+                return
+            if self._try_backoff_retry(rep):
+                return
+            if self._claim():
+                self._cancel_timers()
+                self.app.finish(
+                    self, "shed", 503, body=data,
+                    upstream_headers=up_headers, replica=rep["id"],
+                )
+            return
+        if code >= 500:
+            result = "server_error"
+            self._upstream_result(rep, result)
+            if code != 504:
+                # A 504 is the replica's own deadline verdict on THIS
+                # request — most of the budget is gone, and the miss says
+                # nothing about the replica's health.
+                self.app.registry.mark_failure(rep["id"], f"http_{code}")
+                if self.retry("server_error", rep):
+                    return
+            if self._claim():
+                self._cancel_timers()
+                self.app.finish(
+                    self, "timeout" if code == 504 else "error", code,
+                    body=data, upstream_headers=up_headers,
+                    replica=rep["id"],
+                )
+            return
+        # 4xx: the client's fault travels back unchanged — a malformed
+        # patient stays malformed on every replica; retrying would just
+        # burn fleet capacity on garbage.
+        self._upstream_result(rep, "client_error")
+        if self._claim():
+            self._cancel_timers()
+            self.app.finish(
+                self, "bad_request", code, body=data,
+                upstream_headers=up_headers, replica=rep["id"],
+            )
+
+    def _try_backoff_retry(self, rep: dict) -> bool:
+        """Everything in rotation already shed this request: honor the
+        upstream ``Retry-After`` (bounded by the remaining budget) and
+        try once more — the router-side version of loadgen's patient
+        client. False when the budget cannot cover the wait."""
+        if self.attempts >= self.app.max_attempts:
+            return False
+        try:
+            wait_s = float(self.last_retry_after or 0.0)
+        except ValueError:
+            wait_s = 0.0
+        wait_s = max(0.05, wait_s)
+        if time.monotonic() + wait_s >= self.deadline_mono - 0.05:
+            return False
+        with self._lock:
+            if self._done:
+                return True
+            self.attempts += 1
+        FLEET_RETRIES.inc(reason="shed_backoff")
+
+        def fire():
+            target = self.app.registry.pick() or rep
+            self.app.forwarders.submit(lambda: self.attempt(target))
+
+        self.app.call_later_threadsafe(wait_s, fire)
+        return True
+
+    def _cancel_timers(self) -> None:
+        if self.deadline_timer is not None:
+            self.deadline_timer.cancel()
+        if self.hedge_timer is not None:
+            self.hedge_timer.cancel()
+
+    @staticmethod
+    def _upstream_result(rep: dict, result: str) -> None:
+        FLEET_UPSTREAM.inc(result=result)
+        FLEET_REPLICA_REQUESTS.inc(replica=rep["id"], result=result)
+
+
+class _RouterApp:
+    """The application behind the router's event loop (see module
+    docstring for the endpoint map)."""
+
+    def __init__(self, handle: "RouterHandle", request_timeout_s: float,
+                 hedge_s: float, max_attempts: int, quiet: bool) -> None:
+        self.handle = handle
+        self.registry = handle.registry
+        self.forwarders = handle.forwarders
+        self.recorder = handle.recorder
+        self.request_timeout_s = float(request_timeout_s)
+        self.hedge_s = float(hedge_s)
+        self.max_attempts = int(max_attempts)
+        self.quiet = quiet
+        self.httpd = None  # bound by make_router after the listener exists
+        self.started_at = time.time()
+
+    # -- loop helpers --------------------------------------------------------
+
+    def call_later_threadsafe(self, delay_s: float, fn) -> None:
+        """``call_later`` from any thread: posted onto the loop, where
+        timer creation is legal."""
+        self.httpd._post(lambda: self.httpd.call_later(delay_s, fn))
+
+    # -- transport interface -------------------------------------------------
+
+    def handle_request(self, req, rsp) -> None:
+        if not self.quiet:
+            import sys
+
+            print(f"router {req.method} {req.target}", file=sys.stderr)
+        if req.method == "POST":
+            if req.path == "/predict":
+                self._predict(req, rsp)
+            elif req.path == "/fleet/replicas":
+                self._post_replicas(req, rsp)
+            elif req.path == "/fleet/deploy":
+                self._post_deploy(req, rsp)
+            else:
+                rsp.send_json(
+                    404, {"error": f"no such path: {req.target}"},
+                    close=True,
+                )
+        elif req.method == "GET":
+            self._get(req, rsp)
+        else:
+            rsp.send_json(
+                501, {"error": f"unsupported method {req.method}"},
+                close=True,
+            )
+
+    def handle_protocol_error(self, exc, rsp) -> None:
+        rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+    # -- data path -----------------------------------------------------------
+
+    def _predict(self, req, rsp) -> None:
+        trace = reqtrace.RequestTrace(
+            reqtrace.sanitize_request_id(req.get_header("x-request-id"))
+        )
+        trace.add_phase("parse", trace.t_start, time.perf_counter())
+        deadline_s = self.request_timeout_s
+        raw_deadline = req.get_header("x-request-deadline-ms")
+        if raw_deadline:
+            try:
+                client_s = float(raw_deadline) / 1000.0
+            except ValueError:
+                client_s = 0.0
+            if client_s > 0.0:
+                deadline_s = min(deadline_s, client_s)
+        pin = (req.get_header("x-serve-path") or "").strip().lower() or None
+        job = _ProxyJob(self, trace, rsp, req.body, pin, deadline_s)
+        job.start()
+
+    def finish(
+        self, job: _ProxyJob, outcome: str, code: int, body: bytes,
+        upstream_headers: dict[str, str] | None = None,
+        headers: dict[str, str] | None = None,
+        replica: str | None = None,
+    ) -> None:
+        """The single exit for a routed request: reply, stamp the trace
+        (route = admission → first dispatch is folded into upstream
+        here; the phases partition admission → reply), count, record."""
+        trace = job.trace
+        t_up_end = time.perf_counter()
+        trace.add_phase("upstream", job.t_route0, t_up_end)
+        out_headers = dict(headers or {})
+        if upstream_headers:
+            for name in _PASSTHROUGH_HEADERS:
+                if name in upstream_headers:
+                    out_headers[_canonical(name)] = upstream_headers[name]
+            if "retry-after" in upstream_headers and code == 503:
+                out_headers["Retry-After"] = upstream_headers["retry-after"]
+        if replica is not None:
+            out_headers.setdefault("X-Replica", replica)
+            trace.note(replica=replica)
+        trace.note(attempts=job.attempts)
+        job.responder.send(
+            code, body, "application/json",
+            headers=out_headers, request_id=trace.request_id,
+        )
+        trace.add_phase("respond", t_up_end, time.perf_counter())
+        trace.finish(
+            "ok" if outcome == "ok" else outcome,
+            error=None if outcome == "ok" else f"http_{code}",
+        )
+        FLEET_REQUESTS.inc(outcome=outcome)
+        FLEET_LATENCY.get().observe(trace.total_s)
+        self.recorder.record(trace)
+
+    # -- control plane --------------------------------------------------------
+
+    def _get(self, req, rsp) -> None:
+        path = req.path
+        if path == "/healthz":
+            snap = self.registry.snapshot()
+            ready = sum(1 for r in snap if r["in_rotation"])
+            rsp.send_json(200, {
+                "status": "ok" if ready else "no_ready_replicas",
+                "role": "fleet-router",
+                "replicas_total": len(snap),
+                "replicas_ready": ready,
+                "deploy": self.handle.deploy_status,
+                "uptime_seconds": round(time.time() - self.started_at, 3),
+            })
+        elif path == "/readyz":
+            ready = self.registry.ready_count()
+            rsp.send_json(
+                200 if ready else 503,
+                {
+                    "ready": ready > 0,
+                    "reasons": [] if ready else ["no ready replicas"],
+                    "replicas_ready": ready,
+                },
+            )
+        elif path == "/fleet/replicas":
+            rsp.send_json(200, {"replicas": self.registry.snapshot()})
+        elif path == "/fleet/deploy":
+            rsp.send_json(200, {"deploy": self.handle.deploy_status})
+        elif path == "/debug/requests":
+            try:
+                n = int(req.query_param("n", "64"))
+            except ValueError:
+                rsp.send_json(400, {"error": "n must be an integer"})
+                return
+            rsp.send_json(200, {
+                "stats": self.recorder.stats(),
+                "requests": self.recorder.snapshot(n),
+            })
+        elif path == "/metrics":
+            if req.query_param("format", "prometheus") == "json":
+                rsp.send_json(200, {
+                    "runtime": REGISTRY.snapshot(),
+                    "replicas": self.registry.snapshot(),
+                })
+            else:
+                rsp.send(
+                    200, REGISTRY.render_prometheus().encode(),
+                    "text/plain; version=0.0.4",
+                )
+        else:
+            rsp.send_json(404, {"error": f"no such path: {path}"})
+
+    def _post_replicas(self, req, rsp) -> None:
+        """Registration endpoint (``cli serve --register`` posts here):
+        ``{"id", "url"}`` adds a replica, ``{"deregister": id}`` removes
+        one. Probing begins on the next prober tick; rotation in follows
+        the first ready probe — a registered-but-cold replica never
+        receives traffic."""
+        try:
+            body = json.loads(req.body or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            if "deregister" in body:
+                found = self.registry.deregister(str(body["deregister"]))
+                rsp.send_json(200, {"deregistered": found})
+                return
+            rid, url = body.get("id"), body.get("url")
+            if not rid or not url:
+                raise ValueError('expected {"id": ..., "url": ...} or '
+                                 '{"deregister": id}')
+        except (ValueError, json.JSONDecodeError) as exc:
+            rsp.send_json(400, {"error": str(exc)})
+            return
+        rsp.send_json(200, {"replica": self.registry.register(
+            str(rid), str(url)
+        )})
+
+    def _post_deploy(self, req, rsp) -> None:
+        """Start a rolling deploy (``fleet.deploy.rolling_deploy``) over
+        every registered replica; replies when the rollout is DONE.
+        Single-flight — a rollout in progress answers 409."""
+        try:
+            body = json.loads(req.body or b"{}")
+            model = body.get("model") if isinstance(body, dict) else None
+            if not model or not isinstance(model, str):
+                raise ValueError('expected {"model": "checkpoint path"}')
+        except (ValueError, json.JSONDecodeError) as exc:
+            rsp.send_json(400, {"error": str(exc)})
+            return
+        if not self.handle._deploy_lock.acquire(blocking=False):
+            rsp.send_json(409, {
+                "error": "a rolling deploy is already in progress",
+                "deploy": self.handle.deploy_status,
+            })
+            return
+
+        def run():
+            from machine_learning_replications_tpu.fleet.deploy import (
+                rolling_deploy,
+            )
+
+            try:
+                report = rolling_deploy(
+                    self.registry, model,
+                    status_cb=self.handle._set_deploy_status,
+                )
+            except Exception as exc:
+                report = {
+                    "result": "failed",
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+                self.handle._set_deploy_status(report)
+            finally:
+                self.handle._deploy_lock.release()
+            FLEET_DEPLOYS.inc(result=report.get("result", "failed"))
+            rsp.send_json(
+                200 if report.get("result") == "ok" else 500,
+                {"deploy": report},
+            )
+
+        threading.Thread(
+            target=run, name="fleet-deploy", daemon=True
+        ).start()
+
+
+def _canonical(lower_name: str) -> str:
+    """lower-cased wire header name → canonical echo casing."""
+    return {
+        "x-replica": "X-Replica",
+        "x-model-version": "X-Model-Version",
+        "x-serve-path": "X-Serve-Path",
+    }.get(lower_name, lower_name)
+
+
+class RouterHandle:
+    """A running front-door router: registry + prober + forwarder pool +
+    event-loop HTTP listener."""
+
+    def __init__(self, registry, prober, forwarders, recorder,
+                 httpd=None) -> None:
+        self.registry = registry
+        self.prober = prober
+        self.forwarders = forwarders
+        self.recorder = recorder
+        self.httpd = httpd
+        self.deploy_status: dict | None = None
+        self._deploy_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    def _set_deploy_status(self, status: dict) -> None:
+        self.deploy_status = status
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def start_background(self) -> "RouterHandle":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fleet-router",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self.prober.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.forwarders.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+def make_router(
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    replicas: list[tuple[str, str]] | None = None,
+    request_timeout_s: float = 30.0,
+    hedge_ms: float = 0.0,
+    max_attempts: int = 3,
+    probe_interval_s: float = 0.5,
+    probe_timeout_s: float = 2.0,
+    fail_threshold: int = 2,
+    recover_probes: int = 2,
+    breaker_failures: int = 3,
+    forward_workers: int = 8,
+    trace_capacity: int = 256,
+    tail_quantile: float = 0.99,
+    idle_timeout_s: float = 5.0,
+    max_connections: int = 8192,
+    quiet: bool = True,
+    start_prober: bool = True,
+) -> RouterHandle:
+    """Assemble the front-door router and bind its listener (not yet
+    serving — call ``serve_forever`` or ``start_background``).
+    ``replicas`` seeds the registry with static ``(id, url)`` members;
+    dynamic members register themselves over ``POST /fleet/replicas``
+    (``cli serve --register``). ``hedge_ms`` > 0 enables tail hedging;
+    ``max_attempts`` bounds retry fan-out per request. ``start_prober``
+    exists for tests that drive ``prober.tick()`` by hand."""
+    registry = ReplicaRegistry(
+        fail_threshold=fail_threshold,
+        recover_probes=recover_probes,
+        breaker_failures=breaker_failures,
+    )
+    for rid, url in replicas or []:
+        registry.register(rid, url)
+    prober = HealthProber(
+        registry, interval_s=probe_interval_s, timeout_s=probe_timeout_s
+    )
+    forwarders = _Forwarders(workers=forward_workers)
+    recorder = reqtrace.FlightRecorder(
+        capacity=trace_capacity, tail_quantile=tail_quantile
+    )
+    handle = RouterHandle(registry, prober, forwarders, recorder)
+    app = _RouterApp(
+        handle, request_timeout_s,
+        hedge_s=hedge_ms / 1000.0, max_attempts=max_attempts, quiet=quiet,
+    )
+    try:
+        handle.httpd = EventLoopHttpServer(
+            (host, port), app,
+            idle_timeout_s=idle_timeout_s,
+            max_connections=max_connections,
+        )
+    except BaseException:
+        forwarders.close()
+        raise
+    app.httpd = handle.httpd
+    journal.event(
+        "fleet_router_started",
+        address=list(handle.httpd.server_address[:2]),
+        replicas=[rid for rid, _ in (replicas or [])],
+    )
+    if start_prober:
+        prober.start()
+    return handle

@@ -35,7 +35,10 @@ def load_inference_params(model: str | None = None, pkl: str | None = None):
 
 # Orbax names resolve lazily (PEP 562) so the pickle-import path stays usable
 # in environments without orbax-checkpoint installed.
-_ORBAX_NAMES = ("abstract_like", "restore_params", "save_params")
+_ORBAX_NAMES = (
+    "abstract_like", "restore_params", "save_params",
+    "checkpoint_version", "load_model_versioned",
+)
 
 
 def __getattr__(name):
@@ -58,4 +61,6 @@ __all__ = [
     "abstract_like",
     "restore_params",
     "save_params",
+    "checkpoint_version",
+    "load_model_versioned",
 ]

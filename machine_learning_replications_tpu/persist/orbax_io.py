@@ -78,6 +78,42 @@ def abstract_like(params: Any, *, keep_sharding: bool = True) -> Any:
 _INTEGRITY_FILE = "integrity.json"
 
 
+def checkpoint_version(path: str | os.PathLike) -> int | None:
+    """The monotonic version id stamped into the checkpoint's integrity
+    manifest at publish time, or ``None`` for a checkpoint that predates
+    versioned manifests (or has no manifest at all). Cheap — one small
+    JSON read; never raises (an unreadable manifest reads as unversioned;
+    the restore path still fails loudly on real corruption)."""
+    import json
+
+    manifest_path = os.path.join(
+        os.path.abspath(os.fspath(path)), _INTEGRITY_FILE
+    )
+    try:
+        with open(manifest_path) as f:
+            v = json.load(f).get("version")
+        return int(v) if v is not None else None
+    except (OSError, ValueError, json.JSONDecodeError, TypeError):
+        return None
+
+
+def _next_version(path: str) -> int:
+    """The version the checkpoint about to publish at ``path`` gets:
+    one past the largest version either slot (primary or its retained
+    last-known-good) carries. Consulting BOTH slots keeps the sequence
+    monotonic across the rotation itself — right after a publish the
+    previous version lives in the lastgood slot, and a deploy pipeline
+    comparing ids must never see the counter move backwards."""
+    prev = [
+        v for v in (
+            checkpoint_version(path),
+            checkpoint_version(lastgood.lastgood_path(path)),
+        )
+        if v is not None
+    ]
+    return (max(prev) if prev else 0) + 1
+
+
 def _file_sha256(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -98,10 +134,13 @@ def _payload_files(path: str) -> list[str]:
     return sorted(out)
 
 
-def _write_integrity(path: str) -> None:
+def _write_integrity(path: str, version: int | None = None) -> None:
     """Content-checksum manifest over the finished checkpoint tree
-    (sha256 + byte size per file). Written last in the temp dir, before
-    the atomic publish rename."""
+    (sha256 + byte size per file), plus the checkpoint's monotonic
+    ``version`` id and publish timestamp when given (the deploy
+    pipeline's identity — ``checkpoint_version`` reads it back). Written
+    last in the temp dir, before the atomic publish rename."""
+    from machine_learning_replications_tpu.obs.journal import utc_now_iso
     from machine_learning_replications_tpu.persist.atomicio import (
         fsync_json_dump,
     )
@@ -112,9 +151,11 @@ def _write_integrity(path: str) -> None:
         files[rel] = {
             "sha256": _file_sha256(fp), "bytes": os.path.getsize(fp),
         }
-    fsync_json_dump(
-        os.path.join(path, _INTEGRITY_FILE), {"format": 1, "files": files}
-    )
+    manifest: dict = {"format": 1, "files": files}
+    if version is not None:
+        manifest["version"] = int(version)
+        manifest["published"] = utc_now_iso()
+    fsync_json_dump(os.path.join(path, _INTEGRITY_FILE), manifest)
 
 
 def verify_checkpoint(path: str | os.PathLike, *, deep: bool = True) -> bool:
@@ -200,6 +241,7 @@ def _publish_tree(path: str, write_tree, *, force: bool = True) -> None:
     path = os.path.abspath(os.fspath(path))
     if not force and os.path.exists(path):
         raise FileExistsError(f"checkpoint already exists at {path!r}")
+    version = _next_version(path)
     tmp = f"{path}.tmp.{os.getpid()}"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
@@ -210,7 +252,7 @@ def _publish_tree(path: str, write_tree, *, force: bool = True) -> None:
         # checkpoint untouched); corrupt = bytes torn after checksumming
         # (detected at restore).
         corrupt = faults.fire("persist.save")
-        _write_integrity(tmp)
+        _write_integrity(tmp, version=version)
         if corrupt:
             _corrupt_payload(tmp)
         # Rotate the outgoing primary into the lastgood slot ONLY if it
@@ -241,6 +283,9 @@ def _publish_tree(path: str, write_tree, *, force: bool = True) -> None:
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    from machine_learning_replications_tpu.obs import journal
+
+    journal.event("checkpoint_publish", path=path, version=version)
 
 
 def save_params(path: str | os.PathLike, params: Any, *, force: bool = True) -> None:
@@ -399,6 +444,31 @@ def load_model(path: str | os.PathLike) -> Any:
     single journaled warning, so a serving process built on one says *why*
     its drift monitoring is off instead of silently lacking it."""
     return lastgood.restore_with_fallback(path, _load_model_at)
+
+
+def load_model_versioned(path: str | os.PathLike) -> tuple[Any, dict]:
+    """``load_model`` plus provenance: returns ``(params, info)`` where
+    ``info`` states which directory actually restored and under which
+    version id — ``{"path", "version", "rolled_back"}``. The deploy
+    pipeline keys off this: a corrupt new checkpoint restores the
+    retained last-known-good (``rolled_back=True``, the PREVIOUS
+    version), and the caller must report the rollout as rolled back
+    instead of claiming the target version shipped."""
+    info: dict = {}
+
+    def loader(p: str):
+        out = _load_model_at(p)
+        # Only the loader invocation that SUCCEEDED writes the record.
+        info.update(
+            path=p,
+            version=checkpoint_version(p),
+            rolled_back=os.path.abspath(p)
+            != os.path.abspath(os.fspath(path)),
+        )
+        return out
+
+    params = lastgood.restore_with_fallback(path, loader)
+    return params, info
 
 
 def _load_model_at(path: str) -> Any:

@@ -377,6 +377,36 @@ class SupervisedEngine:
                 journal.event("quality_feed_reenabled", after="engine_restart")
             return
 
+    # -- rolling deploy -------------------------------------------------------
+
+    def swap_engine(self, engine, factory=None) -> None:
+        """Atomically replace the live engine with an already-built,
+        already-WARM one — the rolling-deploy promotion step
+        (``serve.server`` /admin/deploy; docs/FLEET.md). The swap is a
+        reference assignment under the breaker lock, so in-flight flushes
+        finish on the engine they were submitted to and the next flush
+        runs the new one: no request ever observes a half-switched state.
+
+        ``factory`` (when given) also becomes the supervised-restart
+        rebuild path — without this, a post-deploy breaker trip would
+        "recover" by resurrecting the PREVIOUS model version.
+
+        Refused while the breaker is open: the restarter is concurrently
+        rebuilding the OLD engine and the two swaps would race; a
+        degraded replica is out of rotation anyway, so the deploy
+        controller retries it after recovery."""
+        with self._lock:
+            if self._state == "open":
+                raise RuntimeError(
+                    "cannot swap engines while the breaker is open "
+                    "(supervised restart in progress)"
+                )
+            self._engine = engine
+            if factory is not None:
+                self._factory = factory
+            self._fail_streak = 0
+            journal.event("engine_swap", warm=bool(engine.warm))
+
     # -- the guarded compute path -------------------------------------------
 
     def predict(self, X):
@@ -384,6 +414,15 @@ class SupervisedEngine:
         ``BreakerOpen`` instantly while degraded and
         ``ComputeDeadlineExceeded`` on a wedged compute; engine exceptions
         propagate unchanged (after feeding the failure streak)."""
+        return self.predict_tagged(X)[0]
+
+    def predict_tagged(self, X):
+        """``predict`` plus the ``model_version`` of the engine that ran
+        the compute, captured under the same lock ``swap_engine`` takes —
+        the ONLY read that is guaranteed consistent with the bits. Around
+        a rolling deploy, handle-level version state can already name the
+        next version while an in-flight flush finishes on the old engine;
+        reply headers must be built from this tag, not that state."""
         with self._lock:
             # Check + submit under ONE lock acquisition: a wedge trip
             # swapping workers serializes against this, so a submit can
@@ -397,7 +436,8 @@ class SupervisedEngine:
                     else self._backoff_s
                 )
                 raise BreakerOpen(max(1.0, retry_after))
-            fut = self._worker.submit(self._engine.predict, X)
+            engine = self._engine
+            fut = self._worker.submit(engine.predict, X)
         try:
             out = fut.result(timeout=self._deadline_s)
         except FuturesTimeout:
@@ -420,7 +460,7 @@ class SupervisedEngine:
             raise
         with self._lock:
             self._fail_streak = 0
-        return out
+        return out, getattr(engine, "model_version", None)
 
     def close(self) -> None:
         """Stop the worker thread AND any in-flight restarter (idempotent).

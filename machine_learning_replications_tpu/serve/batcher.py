@@ -271,7 +271,22 @@ class MicroBatcher:
                 faults.fire("batcher.flush")
                 X = np.stack([p.row for p in batch])
                 t_c0 = time.perf_counter()
-                probs = np.asarray(self._engine.predict(X), np.float64)
+                # predict_tagged (supervised engines) pairs the probs
+                # with the computing engine's model version, captured
+                # atomically with the engine reference — around a warm
+                # swap, reply headers must name the version of THESE
+                # bits, not whatever the handle says at respond time.
+                # Unsupervised engines cannot be swapped (deploys require
+                # supervision), so a plain attribute read is exact there.
+                tagged = getattr(self._engine, "predict_tagged", None)
+                if tagged is not None:
+                    out, model_version = tagged(X)
+                else:
+                    out = self._engine.predict(X)
+                    model_version = getattr(
+                        self._engine, "model_version", None
+                    )
+                probs = np.asarray(out, np.float64)
                 t_c1 = time.perf_counter()
                 cold = count_compiles() > compiles0
                 sp.note(flush_seq=flush_seq, bucket=bucket,
@@ -326,6 +341,8 @@ class MicroBatcher:
             "bucket": bucket, "cold_compile": cold,
             "padded_rows": max(padded, 0),
             **({"shape": shape} if shape is not None else {}),
+            **({"model_version": model_version}
+               if model_version is not None else {}),
             "flush_tid": tracer.current_tid() if tracer is not None else None,
         })
         if self._metrics is not None:

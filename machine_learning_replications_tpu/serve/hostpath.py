@@ -189,6 +189,14 @@ class HostPath:
     def scorer(self):
         return self._scorer
 
+    def swap_scorer(self, scorer) -> None:
+        """Rolling-deploy promotion for the fast path: replace the scorer
+        with an already-warm one. A bare reference swap — workers read
+        ``self._scorer`` once per compute, so in-flight host scores
+        finish on the old scorer and the next submission runs the new
+        one, mirroring ``SupervisedEngine.swap_engine``."""
+        self._scorer = scorer
+
     @property
     def available(self) -> bool:
         """Router gate: open for submissions and backed by a warm scorer
@@ -250,8 +258,12 @@ class HostPath:
             # batcher's flush-time cancel sweep.
             if not p.future.set_running_or_notify_cancel():
                 return
+            # ONE read of the swappable scorer reference: the version
+            # noted on the trace below must belong to the scorer that
+            # produced the bits, even when swap_scorer lands mid-call.
+            scorer = self._scorer
             try:
-                prob = float(self._scorer.predict(p.row[None, :])[0])
+                prob = float(scorer.predict(p.row[None, :])[0])
             except BaseException as exc:
                 # No error counter here: the server retries a failed host
                 # compute through the device path, whose flush accounts
@@ -262,6 +274,9 @@ class HostPath:
                 return
             t_done = time.perf_counter()
             self._stamp(p, t_claim, t_done)
+            version = getattr(scorer, "model_version", None)
+            if version is not None and p.trace is not None:
+                p.trace.note(model_version=version)
             if self._metrics is not None:
                 now = time.monotonic()
                 self._metrics.queue_wait.observe(

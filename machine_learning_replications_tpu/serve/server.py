@@ -171,6 +171,24 @@ CPU_DEFAULT_MAX_BATCH = 64
 # output contract.
 OUTPUT_CONTRACT = "Probability of progressive HF is: {:.2f} %"
 
+#: Rolling-deploy accounting (docs/FLEET.md): ok = the target version
+#: swapped in; rolled_back = the checkpoint failed to restore and the
+#: retained last-known-good was served instead; failed = nothing swapped
+#: (load/warmup/parity failure — the previous engine keeps serving).
+DEPLOYS = REGISTRY.counter(
+    "serve_deploys_total",
+    "In-place model deploys (/admin/deploy) by result.",
+    labels=("result",),
+)
+#: The served checkpoint's monotonic version id (0 when unversioned —
+#: pickle-imported params or a pre-versioning checkpoint). The loadgen
+#: crossover evidence reads the per-reply X-Model-Version header; this
+#: gauge is the same fact on the scrape side.
+MODEL_VERSION = REGISTRY.gauge(
+    "serve_model_version",
+    "Monotonic checkpoint version currently served (0 = unversioned).",
+)
+
 
 def _retry_after(seconds: float) -> dict[str, str]:
     """``Retry-After`` header for degraded-mode sheds: integer seconds,
@@ -188,6 +206,8 @@ class ServerHandle:
         recorder=None, slo_tracker=None, profile_dir: str | None = None,
         quality=None, worker_id: int | None = None,
         host=None, router=None, quality_feed=None,
+        model_version: int | None = None, replica_id: str | None = None,
+        admin_enabled: bool = False, live=None, say=None,
     ) -> None:
         self.engine = engine
         self.batcher = batcher
@@ -201,6 +221,20 @@ class ServerHandle:
         self.host = host            # hostpath.HostPath or None
         self.router = router        # batcher.PathRouter or None
         self.quality_feed = quality_feed  # AsyncQualityFeed or None
+        # Fleet identity (docs/FLEET.md): the checkpoint version this
+        # replica serves and the id it registered under — echoed on every
+        # reply (X-Model-Version / X-Replica) so the rolling-deploy
+        # crossover is provable from client artifacts alone.
+        self.model_version = model_version
+        self.replica_id = replica_id
+        self.admin_enabled = admin_enabled  # /admin/deploy opt-in
+        # The live-params holder the supervised-restart factory reads
+        # through (make_server) — deploys update it so a post-deploy
+        # restart rebuilds the CURRENT model, not the boot-time one.
+        self.live = live if live is not None else {"params": None}
+        self._say = say
+        self._deploy_lock = threading.Lock()
+        self.deploy_status: dict | None = None
         # Graceful-drain marker: set FIRST in shutdown so /readyz drops
         # before admission closes — a load balancer stops routing here
         # while in-flight requests finish.
@@ -245,6 +279,239 @@ class ServerHandle:
             # Drain-then-stop: rows already handed off still reach the
             # monitor so a post-shutdown snapshot reflects all traffic.
             self.quality_feed.close()
+
+    # -- fleet identity ------------------------------------------------------
+
+    def identity_headers(self) -> dict[str, str]:
+        """Per-reply fleet identity: which replica answered, serving which
+        checkpoint version. The front-door router passes these through,
+        so a client artifact (loadgen's ``fleet`` block) can prove the
+        rolling-deploy crossover without touching a single scrape."""
+        h: dict[str, str] = {}
+        if self.replica_id is not None:
+            h["X-Replica"] = self.replica_id
+        if self.model_version is not None:
+            h["X-Model-Version"] = str(self.model_version)
+        return h
+
+    # -- in-place model deploy ----------------------------------------------
+
+    def deploy_model(self, model_path: str) -> dict:
+        """Warm-swap this replica onto the checkpoint at ``model_path``
+        (docs/FLEET.md "Deploy lifecycle"). Runs on the caller's thread —
+        the /admin/deploy handler spawns one — entirely off the request
+        path: the live engine keeps serving while the new version loads,
+        builds, warms, and proves parity; only then does the atomic swap
+        happen. Single-flight (``RuntimeError`` when one is already in
+        progress). Steps:
+
+          1. ``load_model_versioned``: integrity-verified restore with
+             the last-known-good rollback net — a corrupt checkpoint
+             deploys the PREVIOUS version, loudly (``rolled_back``).
+          2. Build + warm a fresh engine (and host scorer, when the fast
+             path is on) via the supervisor's rebuild machinery.
+          3. Parity probe: the new engine's probabilities must equal the
+             eager oracle composition bit-for-bit on probe rows — the
+             same contract the serve parity suite pins.
+          4. ``SupervisedEngine.swap_engine`` (+ host scorer swap): a
+             reference swap, atomic at flush granularity; the restart
+             factory now rebuilds the new version.
+
+        Any failure before step 4 leaves the previous engine serving and
+        reports ``result="failed"`` — a bad deploy can degrade a replica
+        to its previous model, never to a dead server."""
+        from machine_learning_replications_tpu.persist import orbax_io
+        from machine_learning_replications_tpu.resilience.supervisor import (
+            SupervisedEngine,
+        )
+
+        if not isinstance(self.engine, SupervisedEngine):
+            raise RuntimeError(
+                "in-place deploy requires a supervised engine "
+                "(serve without --no-supervise)"
+            )
+        if not self._deploy_lock.acquire(blocking=False):
+            raise RuntimeError("a deploy is already in progress")
+        t0 = time.monotonic()
+        status: dict = {
+            "state": "loading", "target": model_path,
+            "from_version": self.model_version, "started": time.time(),
+        }
+        self.deploy_status = status
+        journal.event(
+            "deploy_start", path=model_path,
+            from_version=self.model_version, replica=self.replica_id,
+        )
+        try:
+            params, info = orbax_io.load_model_versioned(model_path)
+            status.update(
+                state="warming", to_version=info["version"],
+                rolled_back=info["rolled_back"],
+            )
+            engine_buckets = self.engine.buckets
+            # The new engine keeps feeding the SAME quality monitor only
+            # when the input space is unchanged; a different family (or
+            # lasso support) would feed rows the reference profile cannot
+            # bin, so monitoring detaches, journaled.
+            quality = (
+                self.engine.quality
+                if _same_input_space(self.live.get("params"), params)
+                else None
+            )
+            if quality is None and self.engine.quality is not None:
+                journal.event("deploy_quality_detached", path=model_path)
+
+            def factory():
+                eng = BucketedPredictEngine(
+                    params, buckets=engine_buckets, quality=quality
+                )
+                # The version tags the engine (not just handle state) so
+                # replies name the version of the bits they carry even
+                # across the swap instant — and so a post-deploy
+                # supervised restart rebuilds a correctly-tagged engine.
+                eng.model_version = info["version"]
+                eng.warmup(say=self._say)
+                return eng
+
+            new_engine = factory()
+            new_scorer = None
+            if self.host is not None:
+                new_scorer = HostScorer(
+                    params, buckets=self.host.scorer.buckets,
+                    quality=quality,
+                )
+                new_scorer.model_version = info["version"]
+                new_scorer.warmup(say=self._say)
+            status["state"] = "verifying"
+            _verify_parity(params, new_engine, new_scorer)
+            self.engine.swap_engine(new_engine, factory)
+            if new_scorer is not None:
+                self.host.swap_scorer(new_scorer)
+            self.live["params"] = params
+            self.model_version = info["version"]
+            if info["version"] is not None:
+                MODEL_VERSION.get().set(float(info["version"]))
+            result = "rolled_back" if info["rolled_back"] else "ok"
+            status.update(
+                state="done", result=result, version=info["version"],
+                restored_from=info["path"],
+                seconds=round(time.monotonic() - t0, 3),
+            )
+            DEPLOYS.inc(result=result)
+            journal.event(
+                "deploy_applied", path=model_path,
+                from_version=status["from_version"],
+                to_version=info["version"],
+                rolled_back=info["rolled_back"], replica=self.replica_id,
+                seconds=status["seconds"],
+            )
+            return status
+        except BaseException as exc:
+            status.update(
+                state="done", result="failed",
+                error=f"{type(exc).__name__}: {exc}",
+                seconds=round(time.monotonic() - t0, 3),
+            )
+            DEPLOYS.inc(result="failed")
+            journal.event(
+                "deploy_failed", path=model_path, replica=self.replica_id,
+                error=status["error"], seconds=status["seconds"],
+            )
+            raise
+        finally:
+            self._deploy_lock.release()
+
+
+def _same_input_space(old_params, new_params) -> bool:
+    """True when the new checkpoint scores the same input space the
+    quality monitor was built over: same param family, same lasso
+    support (when the family selects columns)."""
+    if old_params is None or type(old_params) is not type(new_params):
+        return False
+    old_mask = getattr(old_params, "support_mask", None)
+    new_mask = getattr(new_params, "support_mask", None)
+    if (old_mask is None) != (new_mask is None):
+        return False
+    if old_mask is not None:
+        import numpy as np
+
+        if not np.array_equal(np.asarray(old_mask), np.asarray(new_mask)):
+            return False
+    return True
+
+
+def _oracle_probs(params, rows):
+    """The eager single-request composition — the exact route
+    ``cli predict`` takes — as the deploy parity oracle."""
+    import numpy as np
+
+    from machine_learning_replications_tpu.models import (
+        pipeline, stacking, tree,
+    )
+
+    if isinstance(params, pipeline.PipelineParams):
+        out = pipeline.pipeline_predict_proba1_contract(params, rows)
+    elif isinstance(params, tree.TreeEnsembleParams):
+        out = tree.predict_proba1(params, rows)
+    else:
+        out = stacking.predict_proba1(params, rows)
+    return np.asarray(out, np.float64)
+
+
+def _verify_parity(params, engine, scorer=None, n_rows: int = 4) -> None:
+    """Probe-row parity gate for a deploy candidate: the warmed engine
+    (and host scorer) must reproduce the eager oracle at the engine
+    parity contract — XLA fusion may regroup float ops vs op-by-op
+    dispatch, so the tolerance is precision-dependent: rtol 1e-12 under
+    x64 (the serve parity suite's documented bound), 1e-5 under the
+    default float32 mode (fusion noise sits at ~1e-7 relative there;
+    wrong weights differ at 1e-1) — and the host and device paths must
+    agree with EACH OTHER bit-for-bit on the single-row program, before
+    the candidate may swap into rotation. A miscompiled or
+    wrong-weights candidate can never serve a single wrong answer."""
+    import jax
+    import numpy as np
+
+    from machine_learning_replications_tpu.data.examples import patient_row
+
+    base = np.asarray(patient_row(), np.float64)
+    rng = np.random.default_rng(0)
+    rows = np.concatenate(
+        [base] + [
+            base * (1.0 + 0.05 * rng.standard_normal(base.shape))
+            for _ in range(n_rows - 1)
+        ],
+        axis=0,
+    )
+    rtol, atol = (
+        (1e-12, 1e-15) if jax.config.jax_enable_x64 else (1e-5, 1e-8)
+    )
+    want = _oracle_probs(params, rows)
+    got = np.asarray(engine.predict(rows), np.float64)
+    if not np.allclose(got, want, rtol=rtol, atol=atol):
+        raise RuntimeError(
+            "deploy candidate failed the parity probe: engine "
+            f"probabilities {got.tolist()} != oracle {want.tolist()}"
+        )
+    if scorer is not None:
+        got_host = np.asarray(
+            [float(scorer.predict(r[None, :])[0]) for r in rows], np.float64
+        )
+        # Host vs device is the bit-for-bit leg: same composition, same
+        # SINGLE-ROW program shape on both sides (hostpath.py) — any
+        # drift here means the two paths would serve different bits for
+        # the same patient. Compared per-row against the engine's own
+        # single-row program: cross-bucket shapes are only
+        # tolerance-comparable, same-shape programs are bit-comparable.
+        got_single = np.asarray(
+            [float(engine.predict(r[None, :])[0]) for r in rows],
+            np.float64,
+        )
+        if not np.array_equal(got_host, got_single):
+            raise RuntimeError(
+                "deploy candidate failed the host-path parity probe: "
+                f"{got_host.tolist()} != device {got_single.tolist()}"
+            )
 
 
 class _InFlight:
@@ -408,14 +675,23 @@ class _InFlight:
                 app.slo_tracker.observe(trace.total_s, ok=False)
             app.recorder.record(trace)
             return
+        # The taken path rides every reply so clients (loadgen's `paths`
+        # block) can account the routing split without a /metrics scrape
+        # — and the fleet identity (replica id + model version) rides
+        # with it for the deploy crossover. The version comes from the
+        # compute-time tag when one was stamped (batcher flush / host
+        # worker note it from the engine that ran): handle state at
+        # respond time can already name the NEXT version for bits an
+        # in-flight flush computed on the old engine mid-deploy.
+        identity = {"X-Serve-Path": self.path,
+                    **app.handle.identity_headers()}
+        computed_version = trace.meta.get("model_version")
+        if computed_version is not None:
+            identity["X-Model-Version"] = str(computed_version)
         responder.send_json(200, {
             "probability": prob,
             "text": OUTPUT_CONTRACT.format(100.0 * prob),
-        }, request_id=trace.request_id,
-            # The taken path rides every reply so clients (loadgen's
-            # `paths` block) can account the routing split without a
-            # /metrics scrape.
-            headers={"X-Serve-Path": self.path})
+        }, request_id=trace.request_id, headers=identity)
         trace.add_phase("respond", t_resp0, time.perf_counter())
         trace.finish("ok")
         if app.slo_tracker is not None:
@@ -495,7 +771,8 @@ class _App:
         t0 = time.perf_counter()
         rsp.send_json(
             code, {"error": message}, request_id=trace.request_id,
-            headers=headers, close=close,
+            headers={**self.handle.identity_headers(), **(headers or {})},
+            close=close,
         )
         trace.add_phase("respond", t0, time.perf_counter())
         trace.finish(status, error=message)
@@ -553,6 +830,10 @@ class _App:
                     jrn.manifest.get("run_id") if jrn is not None else None
                 ),
                 "worker": handle.worker_id,
+                # Fleet identity: which replica this is and which
+                # checkpoint version it serves (docs/FLEET.md).
+                "replica": handle.replica_id,
+                "model_version": handle.model_version,
                 # Compact drift signal so an orchestrator can act on
                 # model-quality degradation from the same probe it
                 # already polls (full detail: /debug/quality).
@@ -566,8 +847,25 @@ class _App:
             blockers = self._readiness_blockers()
             rsp.send_json(
                 200 if not blockers else 503,
-                {"ready": not blockers, "reasons": blockers},
+                {
+                    "ready": not blockers, "reasons": blockers,
+                    # The fleet prober reads identity off the same probe
+                    # it rotates on: one GET per replica per tick.
+                    "replica": handle.replica_id,
+                    "version": handle.model_version,
+                },
             )
+        elif path == "/admin/deploy":
+            if not handle.admin_enabled:
+                rsp.send_json(403, {
+                    "error": "admin deploy endpoint disabled "
+                    "(start serve with --admin-endpoint)",
+                })
+            else:
+                rsp.send_json(200, {
+                    "deploy": handle.deploy_status,
+                    "model_version": handle.model_version,
+                })
         elif path == "/debug/faults":
             if not faults.endpoint_enabled():
                 rsp.send_json(403, {
@@ -668,6 +966,9 @@ class _App:
         if req.path == "/debug/faults":
             self._post_faults(req, rsp)
             return
+        if req.path == "/admin/deploy":
+            self._post_deploy(req, rsp)
+            return
         if req.path != "/predict":
             # The body was framed and consumed, but a POST to an unknown
             # path keeps the threaded server's contract: reply 404 and
@@ -677,6 +978,53 @@ class _App:
             )
             return
         self._predict(req, rsp)
+
+    def _post_deploy(self, req, rsp) -> None:
+        """POST /admin/deploy ``{"model": PATH}``: warm-swap this replica
+        onto a new checkpoint version (``ServerHandle.deploy_model``).
+        Guarded like /debug/faults — a production server must not be
+        model-swappable by whoever can reach its port. The reply comes
+        when the deploy is DONE (load + warm + parity + swap), so the
+        fleet controller's per-replica step is one long POST; progress is
+        observable meanwhile on GET /admin/deploy. Runs on a dedicated
+        thread — warmup compiles must never stall the event loop."""
+        if not self.handle.admin_enabled:
+            rsp.send_json(403, {
+                "error": "admin deploy endpoint disabled "
+                "(start serve with --admin-endpoint)",
+            }, close=True)
+            return
+        try:
+            body = json.loads(req.body or b"{}")
+            model = body.get("model") if isinstance(body, dict) else None
+            if not model or not isinstance(model, str):
+                raise ValueError('expected {"model": "checkpoint path"}')
+        except (ValueError, json.JSONDecodeError) as exc:
+            rsp.send_json(400, {"error": str(exc)})
+            return
+
+        def run():
+            try:
+                status = self.handle.deploy_model(model)
+            except RuntimeError as exc:
+                busy = "already in progress" in str(exc)
+                rsp.send_json(
+                    409 if busy else 500,
+                    {"error": str(exc),
+                     "deploy": self.handle.deploy_status},
+                )
+                return
+            except Exception as exc:
+                rsp.send_json(500, {
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "deploy": self.handle.deploy_status,
+                })
+                return
+            rsp.send_json(200, {"deploy": status})
+
+        threading.Thread(
+            target=run, name="serve-deploy", daemon=True
+        ).start()
 
     def _post_faults(self, req, rsp) -> None:
         """POST /debug/faults: arm/disarm/reset the injection registry
@@ -863,6 +1211,9 @@ def make_server(
     burst_depth: int = 1,
     tight_deadline_s: float = 0.05,
     quality_async: bool = True,
+    model_version: int | None = None,
+    replica_id: str | None = None,
+    admin_endpoint: bool = False,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
@@ -931,6 +1282,14 @@ def make_server(
     ``/healthz``, ``/metrics`` (``serve_worker_info{worker=…}``), and —
     via the CLI — the journal manifest, so scrapes and journals through
     the shared port stay attributable to a specific worker process.
+
+    Fleet (docs/FLEET.md): ``model_version`` is the served checkpoint's
+    monotonic version id (``persist.checkpoint_version``) and
+    ``replica_id`` the identity this replica registered under — both are
+    echoed per reply (``X-Model-Version`` / ``X-Replica``) and on the
+    health probes. ``admin_endpoint`` opts into the guarded
+    ``/admin/deploy`` warm-swap endpoint (``ServerHandle.deploy_model``)
+    — off by default for the same reason ``/debug/faults`` is.
 
     The listener BINDS before warmup runs: a port conflict fails in
     milliseconds instead of after the multi-second compile bill. Warmup
@@ -1004,6 +1363,12 @@ def make_server(
     engine = BucketedPredictEngine(
         params, buckets=buckets, quality=engine_quality
     )
+    # Fleet identity rides ON the computing engine, not just the handle:
+    # around a warm swap (/admin/deploy), in-flight flushes finish on the
+    # engine they were submitted to, so the version a reply claims must
+    # come from that engine — handle state at respond time can already
+    # name the NEXT version for bits the old engine computed.
+    engine.model_version = model_version
     if supervise:
         engine_buckets = engine.buckets
 
@@ -1015,6 +1380,7 @@ def make_server(
             eng = BucketedPredictEngine(
                 params, buckets=engine_buckets, quality=engine_quality
             )
+            eng.model_version = model_version
             eng.warmup(say=say)
             return eng
 
@@ -1047,6 +1413,7 @@ def make_server(
         scorer = HostScorer(
             params, buckets=host_buckets, quality=engine_quality
         )
+        scorer.model_version = model_version
         host_pool = HostPath(scorer, workers=host_workers, metrics=metrics)
         router = PathRouter(
             batcher, host_pool,
@@ -1072,11 +1439,15 @@ def make_server(
             "constant 1, the worker label carries the id.",
             labels=("worker",),
         ).set(1, worker=str(worker_id))
+    if model_version is not None:
+        MODEL_VERSION.get().set(float(model_version))
     handle = ServerHandle(
         engine, batcher, metrics, None,
         recorder=recorder, slo_tracker=slo_tracker, profile_dir=profile_dir,
         quality=quality_monitor, worker_id=worker_id,
         host=host_pool, router=router, quality_feed=quality_feed,
+        model_version=model_version, replica_id=replica_id,
+        admin_enabled=admin_endpoint, live={"params": params}, say=say,
     )
     app = _App(handle, request_timeout_s, quiet)
     try:

@@ -256,6 +256,26 @@ def _wait(pred, timeout_s=10.0, what="condition"):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+def test_predict_tagged_version_follows_the_computing_engine():
+    # The fleet-identity contract (docs/FLEET.md): the version paired
+    # with the probabilities is the one of the engine that COMPUTED
+    # them, captured under the swap lock — not ambient handle state.
+    eng1 = _ScriptedEngine(["ok"])
+    eng1.model_version = 1
+    sup = SupervisedEngine(eng1, lambda: eng1, flush_deadline_s=1.0)
+    X = np.ones((2, 17))
+    out, version = sup.predict_tagged(X)
+    assert version == 1 and out.shape == (2,)
+    eng2 = _ScriptedEngine(["ok"])
+    eng2.model_version = 2
+    sup.swap_engine(eng2)
+    _, version = sup.predict_tagged(X)
+    assert version == 2
+    # plain predict keeps its bare-probabilities contract
+    assert sup.predict(X).shape == (2,)
+    sup.close()
+
+
 def test_breaker_opens_after_consecutive_failures_then_recovers(run_journal):
     sup, made = _supervised(["fail"])
     X = np.zeros((2, 17))

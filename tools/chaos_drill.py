@@ -47,9 +47,36 @@ Scenarios:
   save_interrupted  offline: ``persist.save:raise`` mid-publish leaves
                     the previous checkpoint fully intact and loadable.
 
+``--fleet`` runs the FLEET drill instead (docs/FLEET.md): two real
+``cli serve`` replica subprocesses self-registered behind an in-process
+front-door router, continuous traffic flowing the whole time, and three
+scenarios asserted under it —
+
+  kill_replica      SIGKILL one replica mid-traffic: the router's
+                    retry/breaker machinery absorbs it (zero client
+                    errors, zero wrong answers, bounded latency), the
+                    registry rotates it out, and a respawned replica
+                    probes back into rotation.
+  rolling_deploy    publish checkpoint v2 and drive ``/fleet/deploy``
+                    under load: both replicas warm-swap one at a time,
+                    zero failed requests, zero wrong answers (every 200
+                    bit-for-bit equal to the CLI golden FOR ITS
+                    VERSION), and the traffic log shows the v1→v2
+                    crossover.
+  corrupt_deploy    corrupt the next checkpoint on disk and deploy: the
+                    replica's restore rolls back to last-known-good
+                    (journaled ``checkpoint_rollback``), the rollout
+                    stops as ``rolled_back``, and the fleet keeps
+                    serving the old version — still zero wrong answers.
+
+The router's ``/metrics`` page is strict-validated and written to
+``--metrics-out`` for CI to re-validate as an artifact.
+
 Run from the repo root (CPU is fine)::
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --out CHAOS_r10_cpu.json
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --fleet \\
+        --out CHAOS_fleet.json --metrics-out fleet_metrics.txt
 """
 
 from __future__ import annotations
@@ -60,6 +87,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -186,6 +214,394 @@ def make_sklearn_params(seed: int):
     return import_stacking(clf)
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Traffic:
+    """Continuous /predict traffic through the router, every reply
+    classified against the per-version golden probabilities. One record
+    per logical request: (t_mono, status, version, latency_ms) — the
+    scenario assertions slice this log by time."""
+
+    def __init__(self, base: str, patient: dict, goldens: dict) -> None:
+        self.base = base
+        self.body = json.dumps(patient).encode()
+        self.goldens = goldens  # {version int: probability float}
+        self.log: list[tuple[float, str, int | None, float]] = []
+        # version -> distinct served probabilities: the bit-for-bit
+        # evidence — one version must serve exactly one bit pattern,
+        # across replicas, kills, and the deploy crossover.
+        self.served_bits: dict[int, set] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _one(self) -> None:
+        req = urllib.request.Request(
+            self.base + "/predict", data=self.body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.monotonic()
+        version = None
+        try:
+            with urllib.request.urlopen(req, timeout=HARD_TIMEOUT_S) as r:
+                payload = json.loads(r.read())
+                raw_v = r.headers.get("X-Model-Version")
+                version = int(raw_v) if raw_v else None
+            golden = self.goldens.get(version)
+            prob = payload["probability"]
+            with self._lock:
+                if version is not None:
+                    self.served_bits.setdefault(version, set()).add(prob)
+            # Correct = the eager golden for the reply's version within
+            # the engine parity tolerance (jit vs eager fusion noise:
+            # ~1e-7 relative in float32 mode); the versions differ at
+            # 1e-1, so a wrong-version or corrupt-weights answer cannot
+            # pass. Bit consistency per version is asserted over
+            # served_bits.
+            status = (
+                "ok" if golden is not None
+                and abs(prob - golden) <= 1e-6 else "wrong"
+            )
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            status = f"http_{exc.code}"
+        except Exception:
+            status = (
+                "hang"
+                if time.monotonic() - t0 >= HARD_TIMEOUT_S - 0.05
+                else "conn_err"
+            )
+        with self._lock:
+            self.log.append((
+                t0, status, version,
+                (time.monotonic() - t0) * 1000.0,
+            ))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._one()
+            time.sleep(0.02)
+
+    def start(self) -> "_Traffic":
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-traffic", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=HARD_TIMEOUT_S + 5)
+
+    def window(self, t_from: float, t_to: float | None = None) -> dict:
+        """Outcome counts, version split, and latency p99 over requests
+        STARTED in [t_from, t_to)."""
+        with self._lock:
+            rows = [
+                r for r in self.log
+                if r[0] >= t_from and (t_to is None or r[0] < t_to)
+            ]
+        counts: dict[str, int] = {}
+        versions: dict[str, int] = {}
+        lats = []
+        for _, status, version, ms in rows:
+            counts[status] = counts.get(status, 0) + 1
+            if status == "ok" and version is not None:
+                versions[str(version)] = versions.get(str(version), 0) + 1
+            lats.append(ms)
+        lats.sort()
+        p99 = (
+            lats[min(len(lats) - 1, round(0.99 * (len(lats) - 1)))]
+            if lats else None
+        )
+        return {
+            "requests": len(rows),
+            "outcomes": dict(sorted(counts.items())),
+            "versions": versions,
+            "p99_ms": round(p99, 1) if p99 is not None else None,
+        }
+
+
+def _spawn_replica(rid: str, port: int, ckpt: str, register_url: str,
+                   journal_path: str):
+    """One real ``cli serve`` replica subprocess: admin endpoint on (the
+    rollout target), self-registering with the router."""
+    return subprocess.Popen(
+        [sys.executable, "-m", "machine_learning_replications_tpu",
+         "serve", "--model", ckpt, "--port", str(port),
+         "--buckets", "1,8", "--max-wait-ms", "2",
+         "--replica-id", rid, "--register", register_url,
+         "--admin-endpoint", "--journal", journal_path],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _corrupt_largest_payload(ckpt: str) -> None:
+    best, size = None, -1
+    for root, _dirs, names in os.walk(ckpt):
+        for name in names:
+            fp = os.path.join(root, name)
+            if name != "integrity.json" and os.path.getsize(fp) > size:
+                best, size = fp, os.path.getsize(fp)
+    with open(best, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]) if first else b"\x00")
+
+
+def run_fleet_drill(args) -> int:
+    """The fleet drill (see module docstring): two replica subprocesses
+    behind an in-process router, traffic flowing throughout."""
+    import signal
+
+    import numpy as np
+
+    t_start = time.monotonic()
+    from machine_learning_replications_tpu.data.examples import (
+        EXAMPLE_PATIENT, patient_row,
+    )
+    from machine_learning_replications_tpu.fleet import make_router
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.obs import journal
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    workdir = tempfile.mkdtemp(prefix="chaos_fleet_")
+    journal_path = args.journal or os.path.join(workdir, "router.jsonl")
+    jrn = journal.RunJournal(journal_path, command="chaos_drill --fleet")
+    journal.set_journal(jrn)
+
+    ckpt = os.path.join(workdir, "model")
+    p_v1, p_v2 = make_sklearn_params(seed=7), make_sklearn_params(seed=11)
+    goldens = {
+        1: float(np.asarray(stacking.predict_proba1(p_v1, patient_row()))[0]),
+        2: float(np.asarray(stacking.predict_proba1(p_v2, patient_row()))[0]),
+    }
+    assert goldens[1] != goldens[2], "versions must be distinguishable"
+    orbax_io.save_model(ckpt, p_v1)  # publishes as version 1
+
+    router = make_router(
+        port=0, probe_interval_s=0.2, request_timeout_s=8.0,
+        hedge_ms=300.0, max_attempts=3,
+    ).start_background()
+    base = f"http://{router.address[0]}:{router.address[1]}"
+    ports = {"r1": _free_port(), "r2": _free_port()}
+    replica_journals = {
+        rid: os.path.join(workdir, f"replica_{rid}.jsonl") for rid in ports
+    }
+    procs = {
+        rid: _spawn_replica(
+            rid, port, ckpt, base, replica_journals[rid]
+        )
+        for rid, port in ports.items()
+    }
+    scenarios: dict[str, dict] = {}
+    traffic = None
+    try:
+        wait_until(
+            lambda: router.registry.ready_count() == 2, 240.0,
+            "both replicas registered, warm, and in rotation",
+            poll_s=0.5,
+        )
+        traffic = _Traffic(base, dict(EXAMPLE_PATIENT), goldens).start()
+        time.sleep(2.0)  # a baseline window of healthy two-replica traffic
+
+        # --- scenario: kill_replica ---------------------------------------
+        t0 = time.monotonic()
+        procs["r1"].send_signal(signal.SIGKILL)
+        procs["r1"].wait()
+        wait_until(
+            lambda: not (router.registry.get("r1") or {}).get(
+                "in_rotation", True
+            ),
+            30.0, "killed replica rotated out", poll_s=0.2,
+        )
+        time.sleep(2.0)  # single-replica traffic window
+        win = traffic.window(t0)
+        scenarios["kill_replica"] = win
+        assert win["requests"] > 0, win
+        assert set(win["outcomes"]) <= {"ok"}, (
+            "kill-replica window saw client-visible failures", win,
+        )
+        # Respawn: same id + port re-registers idempotently and probes
+        # back into rotation.
+        procs["r1"] = _spawn_replica(
+            "r1", ports["r1"], ckpt, base, replica_journals["r1"] + ".2"
+        )
+        wait_until(
+            lambda: router.registry.ready_count() == 2, 240.0,
+            "respawned replica back in rotation", poll_s=0.5,
+        )
+
+        # --- scenario: rolling_deploy -------------------------------------
+        orbax_io.save_model(ckpt, p_v2)  # publishes as version 2
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            base + "/fleet/deploy",
+            data=json.dumps({"model": ckpt}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            report = json.loads(resp.read())["deploy"]
+        assert report["result"] == "ok" and \
+            report["target_version"] == 2, report
+        time.sleep(2.0)  # post-deploy window at v2
+        win = traffic.window(t0)
+        scenarios["rolling_deploy"] = {**win, "report": report}
+        assert set(win["outcomes"]) <= {"ok"}, (
+            "rolling deploy dropped or corrupted requests", win,
+        )
+        assert set(win["versions"]) == {"1", "2"}, (
+            "no version crossover observed", win,
+        )
+        snap = router.registry.snapshot()
+        assert all(
+            r["version"] == 2 and r["in_rotation"] for r in snap
+        ), snap
+
+        # --- scenario: corrupt_deploy -------------------------------------
+        orbax_io.save_model(ckpt, p_v1)  # version 3 content…
+        _corrupt_largest_payload(ckpt)   # …torn on disk
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            base + "/fleet/deploy",
+            data=json.dumps({"model": ckpt}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                report = json.loads(resp.read())["deploy"]
+        except urllib.error.HTTPError as exc:
+            report = json.loads(exc.read())["deploy"]
+        assert report["result"] == "rolled_back", report
+        time.sleep(2.0)
+        win = traffic.window(t0)
+        scenarios["corrupt_deploy"] = {**win, "report": report}
+        assert set(win["outcomes"]) <= {"ok"}, (
+            "corrupt-deploy rollback leaked failures to clients", win,
+        )
+        assert set(win["versions"]) == {"2"}, (
+            "fleet left the known-good version during a rolled-back "
+            "deploy", win,
+        )
+        snap = router.registry.snapshot()
+        assert all(r["in_rotation"] for r in snap), snap
+
+        traffic.stop()
+        overall = traffic.window(0.0)
+        # Bit-for-bit per version: every 200 of one version carried the
+        # same bits, across replicas, the kill, and both deploys.
+        for version, bits in traffic.served_bits.items():
+            assert len(bits) == 1, (
+                f"version {version} served {len(bits)} distinct bit "
+                f"patterns: {sorted(bits)}"
+            )
+
+        # Router metrics: evidence + strict exposition.
+        with urllib.request.urlopen(
+            base + "/metrics", timeout=HARD_TIMEOUT_S
+        ) as resp:
+            page = resp.read().decode()
+        for family in ("fleet_requests_total", "fleet_replicas",
+                       "fleet_rotations_total", "fleet_probe_total",
+                       "fleet_deploys_total",
+                       "fleet_request_latency_seconds"):
+            assert family in page, f"{family} missing from router /metrics"
+        from validate_metrics import validate  # noqa: E402
+
+        errs = validate(page)
+        assert not errs, f"router /metrics failed validation: {errs[:5]}"
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                f.write(page)
+            print(f"router metrics written to {args.metrics_out}",
+                  file=sys.stderr)
+    finally:
+        if traffic is not None:
+            traffic.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        router.shutdown()
+        journal.set_journal(None)
+        jrn.close()
+
+    # Journal evidence: the registration → rotation → deploy arc on the
+    # router side, the rollback on the replica side.
+    with open(journal_path) as f:
+        events = [json.loads(line) for line in f]
+    kinds = {e.get("kind") for e in events}
+    for needed in ("fleet_router_started", "fleet_replica_registered",
+                   "fleet_rotation", "fleet_deploy_start",
+                   "fleet_deploy_replica", "fleet_deploy_done"):
+        assert needed in kinds, f"router journal lacks {needed!r}"
+    replica_kinds = set()
+    for path in list(replica_journals.values()) + [
+        replica_journals["r1"] + ".2"
+    ]:
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    replica_kinds.add(json.loads(line).get("kind"))
+    for needed in ("deploy_start", "deploy_applied", "checkpoint_rollback"):
+        assert needed in replica_kinds, (
+            f"replica journals lack {needed!r} ({sorted(replica_kinds)})"
+        )
+
+    wrong = sum(
+        s["outcomes"].get("wrong", 0) for s in scenarios.values()
+    )
+    hangs = sum(s["outcomes"].get("hang", 0) for s in scenarios.values())
+    artifact = {
+        "kind": "chaos_drill_fleet",
+        "manifest": journal.run_manifest(command="chaos_drill --fleet"),
+        "invariant": {
+            "statement": "through the router, under replica kill and "
+            "good/bad rolling deploys: every request a correct answer "
+            "for its version (one bit pattern per version, equal to "
+            "the eager CLI golden at the engine parity tolerance) or "
+            "an explicit failure; zero wrong answers, zero hangs, "
+            "bounded p99",
+            "wrong_answers": wrong,
+            "hangs": hangs,
+            "holds": wrong == 0 and hangs == 0,
+        },
+        "traffic_total": overall,
+        "scenarios": scenarios,
+        "router_journal_kinds": sorted(k for k in kinds if k),
+        "replica_journal_kinds": sorted(
+            k for k in replica_kinds if k
+        ),
+        "duration_s": round(time.monotonic() - t_start, 3),
+    }
+    line = json.dumps(artifact, indent=1)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"artifact written to {args.out}", file=sys.stderr)
+    assert artifact["invariant"]["holds"], "FLEET CHAOS INVARIANT VIOLATED"
+    print(
+        "fleet chaos invariant holds: zero wrong answers, zero hangs, "
+        f"p99 {overall['p99_ms']} ms over {overall['requests']} requests",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--out", default=None, help="artifact path (JSON)")
@@ -193,7 +609,21 @@ def main(argv=None) -> int:
         "--journal", default=None,
         help="journal path (default: a temp file, embedded in the artifact)",
     )
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="run the FLEET drill instead: 2 replica subprocesses behind "
+        "the front-door router — kill-replica, rolling-deploy, and "
+        "corrupt-deploy scenarios under continuous traffic "
+        "(docs/FLEET.md)",
+    )
+    ap.add_argument(
+        "--metrics-out", default=None,
+        help="(--fleet) write the router's final /metrics page here "
+        "after strict validation",
+    )
     args = ap.parse_args(argv)
+    if args.fleet:
+        return run_fleet_drill(args)
 
     t_start = time.monotonic()
     from machine_learning_replications_tpu.data.examples import EXAMPLE_PATIENT

@@ -49,6 +49,14 @@ onset time, so a ``/debug/quality`` snapshot or journal
 ``quality_status`` transition can be joined against exactly when the
 distribution moved.
 
+Against a fleet (the front-door router or a single identity-carrying
+replica — docs/FLEET.md), the echoed ``X-Replica`` / ``X-Model-Version``
+headers are tallied into the artifact's ``fleet`` block: ok replies per
+replica and per checkpoint version with first/last-seen run offsets —
+the zero-downtime rolling-deploy proof reads straight out of one loadgen
+artifact (old version last seen at t, new version first seen ≈ t, ok
+counts on both sides).
+
 The server echoes (or assigns) an ``X-Request-Id`` on every reply; the
 worst-latency request ids land in the artifact (``worst_requests``), so a
 bench artifact can be joined against the server's ``/debug/requests``
@@ -210,6 +218,15 @@ class _Tally:
         # Per-path ok latencies, so the artifact can state the host-path
         # p50 next to the device-path p50 in one run.
         self.path_latency_ms: dict[str, list[float]] = {}
+        # Fleet identity off the echoed X-Replica / X-Model-Version
+        # headers (docs/FLEET.md): ok counts per replica, per version
+        # (with first/last-seen run offsets — the rolling-deploy
+        # crossover read straight out of the artifact), and the
+        # replica × version matrix.
+        self.t0 = 0.0  # armed by the run loops; offsets are run-relative
+        self.replicas: dict[str, int] = {}
+        self.versions: dict[str, dict] = {}
+        self.replica_versions: dict[str, dict[str, int]] = {}
         # (latency_ms, request_id, status) for every id-carrying reply;
         # reduced to the n_worst slowest at artifact time. One tuple per
         # request is fine for bench durations (minutes, not days).
@@ -217,8 +234,10 @@ class _Tally:
 
     def record(
         self, status: str, latency_ms: float, request_id: str | None = None,
-        path: str | None = None,
+        path: str | None = None, replica: str | None = None,
+        version: str | None = None,
     ) -> None:
+        now_s = time.monotonic() - self.t0
         with self.lock:
             if status == "ok":
                 self.n_ok += 1
@@ -226,12 +245,53 @@ class _Tally:
                 key = path or "unknown"
                 self.paths[key] = self.paths.get(key, 0) + 1
                 self.path_latency_ms.setdefault(key, []).append(latency_ms)
+                if replica:
+                    self.replicas[replica] = \
+                        self.replicas.get(replica, 0) + 1
+                if version:
+                    v = self.versions.setdefault(version, {
+                        "n": 0, "first_s": now_s, "last_s": now_s,
+                    })
+                    v["n"] += 1
+                    v["first_s"] = min(v["first_s"], now_s)
+                    v["last_s"] = max(v["last_s"], now_s)
+                if replica and version:
+                    by = self.replica_versions.setdefault(replica, {})
+                    by[version] = by.get(version, 0) + 1
             elif status == "shed":
                 self.n_shed += 1
             else:
                 self.n_err += 1
             if request_id:
                 self.ided.append((latency_ms, request_id, status))
+
+    def fleet_block(self) -> dict | None:
+        """The artifact's ``fleet`` block: ok-reply distribution over the
+        replicas and checkpoint versions that answered (echoed
+        ``X-Replica`` / ``X-Model-Version`` headers). The per-version
+        first/last-seen offsets are the zero-downtime rolling-deploy
+        proof: old version last seen at t, new version first seen at t'
+        ≈ t, ok counts on both sides, nothing lost between. None against
+        a server that predates the fleet tier."""
+        with self.lock:
+            if not self.replicas and not self.versions:
+                return None
+            return {
+                "source": "reply_headers",
+                "replicas": dict(sorted(self.replicas.items())),
+                "versions": {
+                    k: {
+                        "n": v["n"],
+                        "first_s": round(v["first_s"], 3),
+                        "last_s": round(v["last_s"], 3),
+                    }
+                    for k, v in sorted(self.versions.items())
+                },
+                "by_replica_version": {
+                    r: dict(sorted(vs.items()))
+                    for r, vs in sorted(self.replica_versions.items())
+                },
+            }
 
     def paths_block(self) -> dict | None:
         """The artifact's ``paths`` block: ok-reply counts and latency
@@ -387,8 +447,9 @@ class _KeepAliveClient:
         return resp
 
     def post_predict(self, body: bytes):
-        """(status, x_request_id, retry_after, serve_path) — raises on
-        transport errors (after the one fresh-connection resend)."""
+        """(status, x_request_id, retry_after, serve_path, replica,
+        version) — raises on transport errors (after the one
+        fresh-connection resend)."""
         if self.conn is None:
             self._open()
             resp = self._once(body)
@@ -407,6 +468,8 @@ class _KeepAliveClient:
             resp.getheader("X-Request-Id"),
             resp.getheader("Retry-After"),
             resp.getheader("X-Serve-Path"),
+            resp.getheader("X-Replica"),
+            resp.getheader("X-Model-Version"),
         )
 
 
@@ -420,9 +483,10 @@ def _fire_keepalive(
     attempt = 0
     t0 = time.monotonic()
     while True:
-        rid = retry_after = path = None
+        rid = retry_after = path = replica = version = None
         try:
-            code, rid, retry_after, path = client.post_predict(body)
+            code, rid, retry_after, path, replica, version = \
+                client.post_predict(body)
             status = _classify(code)
         except Exception:
             status = "err"
@@ -435,7 +499,10 @@ def _fire_keepalive(
             time.sleep(sleep_s)
             attempt += 1
             continue
-        tally.record(status, latency_ms, rid, path=path)
+        tally.record(
+            status, latency_ms, rid, path=path, replica=replica,
+            version=version,
+        )
         return
 
 
@@ -513,6 +580,7 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
     sel = selectors.DefaultSelector()
     t_start = time.monotonic()
     bodies.arm(t_start)
+    tally.t0 = t_start
     stop = t_start + duration
     interval = 1.0 / rate_per_conn if rate_per_conn > 0 else 0.0
     conns = [_EvConn() for _ in range(connections)]
@@ -583,7 +651,7 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
         c.closed = True
 
     def finish(c: _EvConn, status: str, rid, retry_after,
-               path=None) -> None:
+               path=None, replica=None, version=None) -> None:
         """A reply (or terminal failure) for the logical request."""
         now = time.monotonic()
         latency_ms = (now - c.t0) * 1000.0
@@ -597,7 +665,10 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
             c.pending_new = False
             unregister(c)
             return
-        tally.record(status, latency_ms, rid, path=path)
+        tally.record(
+            status, latency_ms, rid, path=path, replica=replica,
+            version=version,
+        )
         c.requests_done += 1
         if now < stop:
             if interval and c.next_at > now:
@@ -665,6 +736,8 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
                 c, status, headers.get("x-request-id"),
                 headers.get("retry-after"),
                 path=headers.get("x-serve-path"),
+                replica=headers.get("x-replica"),
+                version=headers.get("x-model-version"),
             )
         now = time.monotonic()
         for c in conns:
@@ -724,12 +797,14 @@ def _fire(
             url + "/predict", data=body,
             headers={"Content-Type": "application/json"},
         )
-        rid = retry_after = path = None
+        rid = retry_after = path = replica = version = None
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 resp.read()
                 rid = resp.headers.get("X-Request-Id")
                 path = resp.headers.get("X-Serve-Path")
+                replica = resp.headers.get("X-Replica")
+                version = resp.headers.get("X-Model-Version")
                 status = _classify(resp.status)
         except urllib.error.HTTPError as exc:
             exc.read()
@@ -752,7 +827,10 @@ def _fire(
             time.sleep(sleep_s)
             attempt += 1
             continue
-        tally.record(status, latency_ms, rid, path=path)
+        tally.record(
+            status, latency_ms, rid, path=path, replica=replica,
+            version=version,
+        )
         return
 
 
@@ -762,6 +840,7 @@ def run_closed(url, bodies, duration, concurrency, timeout, tally,
     (one per worker). Returns (wall_s, connection_stats)."""
     t0 = time.monotonic()
     bodies.arm(t0)
+    tally.t0 = t0
     stop = t0 + duration
     clients = [_KeepAliveClient(url, timeout) for _ in range(concurrency)]
 
@@ -812,6 +891,7 @@ def run_open(url, bodies, duration, qps, timeout, tally):
     threads = []
     t0 = time.monotonic()
     bodies.arm(t0)
+    tally.t0 = t0
     for i in range(n):
         target = t0 + i * interval
         delay = target - time.monotonic()
@@ -999,6 +1079,11 @@ def main(argv=None) -> int:
         # and latency quantiles from the echoed X-Serve-Path header.
         # Null against a server that predates the router.
         "paths": tally.paths_block(),
+        # Fleet distribution (docs/FLEET.md): ok replies per replica and
+        # per checkpoint version with first/last-seen offsets — the
+        # zero-downtime rolling-deploy crossover, client-side. Null
+        # against a server that predates the fleet tier.
+        "fleet": tally.fleet_block(),
         # Keep-alive reuse accounting (closed loop): opened_total near
         # n_connections means persistent connections really persisted;
         # reconnects counts idle-reap races absorbed by a fresh-socket

@@ -31,6 +31,13 @@ Sources (mix live and file freely; stdlib only):
                    the run's quality.json), and --score-bench for the
                    SCORE_BENCH_*.json sequential-vs-overlapped cells
   --score-bench PATH  a tools/score_bench.py artifact
+  --fleet          render the "Fleet" section instead of the serving
+                   sections: the router's replica table, the journal's
+                   registration/rotation/deploy arc, and the fleet_*
+                   routing counters — --url then points at the ROUTER
+                   (fetches /healthz, /metrics?format=json,
+                   /fleet/replicas, /debug/requests), or join a saved
+                   --metrics snapshot with the router's --journal
   --out PATH       write the report there (default: stdout)
 
 Example:
@@ -375,11 +382,123 @@ def _section_score(
             rep.kv("bench manifest run id", digest)
 
 
+def _section_fleet(
+    rep: Report, replicas: list | None, runtime: dict | None,
+    events: list[dict],
+):
+    """The "Fleet" section: the router's rotation table joined with the
+    journal's registration/rotation/deploy arc and the fleet_* routing
+    counters — one place that answers "what did the fleet do" after a
+    drill or a rollout (docs/FLEET.md)."""
+    rep.h("Fleet")
+    if replicas is None and runtime is None and not events:
+        rep.kv("fleet", "unavailable (no --url / --metrics / --journal)")
+        return
+    if replicas:
+        rep.table(
+            ("replica", "state", "in rotation", "version", "url"),
+            [
+                (
+                    r.get("id"), r.get("reason") and
+                    f"{r.get('state')} ({r.get('reason')})" or
+                    r.get("state"),
+                    r.get("in_rotation"), r.get("version"), r.get("url"),
+                )
+                for r in replicas
+            ],
+        )
+        rep.lines.append("")
+    runtime = runtime or {}
+    outcomes = runtime.get("fleet_requests_total")
+    if isinstance(outcomes, dict):
+        rep.kv("routed requests", ", ".join(
+            f"{k.split('=', 1)[1]}={v}" for k, v in sorted(outcomes.items())
+            if v
+        ) or "none")
+    retries = runtime.get("fleet_retries_total")
+    if isinstance(retries, dict) and any(retries.values()):
+        rep.kv("retries", ", ".join(
+            f"{k.split('=', 1)[1]}={v}" for k, v in sorted(retries.items())
+            if v
+        ))
+    hedges = runtime.get("fleet_hedges_total")
+    if hedges:
+        rep.kv(
+            "hedges",
+            f"{hedges} fired, {runtime.get('fleet_hedge_wins_total', 0)} won",
+        )
+    lat = runtime.get("fleet_request_latency_seconds")
+    if isinstance(lat, dict) and lat.get("count"):
+        rep.kv(
+            "router latency mean",
+            _ms(lat["sum"] / lat["count"]) + f" over {lat['count']} requests",
+        )
+    probes = runtime.get("fleet_probe_total")
+    if isinstance(probes, dict) and any(probes.values()):
+        rep.kv("probes", ", ".join(
+            f"{k.split('=', 1)[1]}={v}" for k, v in sorted(probes.items())
+            if v
+        ))
+    per_replica = runtime.get("fleet_replica_requests_total")
+    if isinstance(per_replica, dict) and per_replica:
+        rep.kv("per-replica attempts", ", ".join(
+            f"{k}={v}" for k, v in sorted(per_replica.items()) if v
+        ))
+    registrations = [
+        e for e in events if e.get("kind") == "fleet_replica_registered"
+    ]
+    if registrations:
+        rep.kv("registrations", ", ".join(
+            f"{e.get('replica')} at {e.get('ts')}" for e in registrations
+        ))
+    rotations = [e for e in events if e.get("kind") == "fleet_rotation"]
+    if rotations:
+        rep.lines.append("")
+        rep.table(
+            ("when", "replica", "rotation", "reason", "version"),
+            [
+                (
+                    e.get("ts"), e.get("replica"), e.get("direction"),
+                    e.get("reason"), e.get("version"),
+                )
+                for e in rotations
+            ],
+        )
+    deploys = [
+        e for e in events
+        if e.get("kind") in ("fleet_deploy_start", "fleet_deploy_replica",
+                             "fleet_deploy_done")
+    ]
+    if deploys:
+        rep.lines.append("")
+        rows = []
+        for e in deploys:
+            if e["kind"] == "fleet_deploy_start":
+                what = (
+                    f"start → version {e.get('target_version')} "
+                    f"over {len(e.get('replicas') or [])} replicas"
+                )
+            elif e["kind"] == "fleet_deploy_replica":
+                what = (
+                    f"replica {e.get('replica')}: {e.get('result')} "
+                    f"(version {e.get('achieved_version')}"
+                    + (", ROLLED BACK" if e.get("rolled_back") else "")
+                    + ")"
+                )
+            else:
+                what = (
+                    f"done: {e.get('result')}"
+                    + (f" — {e.get('error')}" if e.get("error") else "")
+                )
+            rows.append((e.get("ts"), e.get("model"), what))
+        rep.table(("when", "model", "deploy arc"), rows)
+
+
 def _phase_summary(trace: dict) -> str:
     phases = trace.get("phases") or {}
     parts = []
     for name in ("parse", "queue_wait", "batch_assembly",
-                 "device_compute", "host_compute", "respond"):
+                 "device_compute", "host_compute", "upstream", "respond"):
         if name in phases:
             parts.append(f"{name} {_ms(phases[name].get('seconds'))}")
     extra = []
@@ -504,6 +623,12 @@ def main(argv=None) -> int:
         "--score-bench", help="tools/score_bench.py SCORE_BENCH_*.json "
         "artifact",
     )
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="render the 'Fleet' section (router replica table + "
+        "journal registration/rotation/deploy arc + fleet_* counters); "
+        "--url then points at the router",
+    )
     ap.add_argument("--tail", type=int, default=10,
                     help="slowest sampled traces to show")
     ap.add_argument("--out", help="report path (default: stdout)")
@@ -512,19 +637,28 @@ def main(argv=None) -> int:
             or args.quality or args.score_bench):
         ap.error("nothing to report on: give --url and/or input files")
 
-    health = metrics = requests = quality = None
+    health = metrics = requests = quality = fleet_replicas = None
     if args.url:
         base = args.url.rstrip("/")
-        health = _fetch_json(base + "/healthz")
         metrics = _fetch_json(base + "/metrics?format=json")
-        # Ask for everything the recorder holds (its ring caps the
-        # count): the endpoint's n=64 default would silently drop the
-        # very samples the Bench join needs.
-        requests = _fetch_json(base + "/debug/requests?n=1000000")
-        try:
-            quality = _fetch_json(base + "/debug/quality")
-        except urllib.error.HTTPError:
-            quality = None  # pre-quality server: section reads unavailable
+        if args.fleet:
+            # --url is the ROUTER: its health/debug surface differs from
+            # a replica's (no /debug/quality, a registry instead of an
+            # engine), so fetch the fleet-specific endpoints.
+            fleet_replicas = _fetch_json(
+                base + "/fleet/replicas"
+            ).get("replicas")
+            requests = _fetch_json(base + "/debug/requests?n=1000000")
+        else:
+            health = _fetch_json(base + "/healthz")
+            # Ask for everything the recorder holds (its ring caps the
+            # count): the endpoint's n=64 default would silently drop the
+            # very samples the Bench join needs.
+            requests = _fetch_json(base + "/debug/requests?n=1000000")
+            try:
+                quality = _fetch_json(base + "/debug/quality")
+            except urllib.error.HTTPError:
+                quality = None  # pre-quality server: section unavailable
     if args.metrics:
         metrics = _load_json(args.metrics)
     if args.requests:
@@ -539,7 +673,19 @@ def main(argv=None) -> int:
 
     rep = Report()
     _section_run(rep, manifest, health)
-    if args.score or score_bench is not None:
+    if args.fleet:
+        # The fleet section replaces the replica-side serving sections:
+        # a router has rotation state and routing counters, not an
+        # engine's traffic/SLO/quality story.
+        if fleet_replicas is None and isinstance(metrics, dict):
+            fleet_replicas = metrics.get("replicas")
+        _section_fleet(
+            rep, fleet_replicas, (metrics or {}).get("runtime"), events,
+        )
+        _section_tail(rep, requests, n=args.tail)
+        if args.journal:
+            _section_journal(rep, events)
+    elif args.score or score_bench is not None:
         # Bulk-scoring runs have no serving traffic/SLO story: the score
         # section replaces them, reusing --journal and --quality (pointed
         # at the run's quality.json).
