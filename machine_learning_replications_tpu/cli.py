@@ -863,6 +863,9 @@ def _run_fleet_router(args) -> int:
         breaker_failures=args.breaker_failures,
         forward_workers=args.forward_workers,
         quiet=not args.verbose,
+        capture_dir=args.capture,
+        capture_rows_per_shard=args.capture_rows_per_shard,
+        capture_max_shards=args.capture_max_shards,
     )
     host, port = handle.address
     print(
@@ -967,6 +970,211 @@ def _run_fleet_status(args) -> int:
     except (urllib.error.URLError, OSError) as exc:
         raise SystemExit(f"fleet status request to {args.router} failed: {exc}")
     print(json.dumps({"router": health, "replicas": replicas}, indent=1))
+    return 0
+
+
+def _learn_thresholds(args):
+    from machine_learning_replications_tpu.learn.shadow import (
+        ShadowThresholds,
+    )
+
+    return ShadowThresholds(
+        max_divergence_mean=args.max_divergence_mean,
+        max_divergence_p95=args.max_divergence_p95,
+        max_flip_rate=args.max_flip_rate,
+        max_score_psi=args.max_score_psi,
+        max_candidate_psi=args.max_candidate_psi,
+        max_disagreement_delta=args.max_disagreement_delta,
+        min_rows=args.shadow_min_rows,
+        require_candidate_profile=not args.allow_no_profile,
+    )
+
+
+def cmd_learn(args) -> int:
+    """Continual learning (docs/CONTINUAL.md): drift-triggered retraining,
+    shadow evaluation, and guarded promotion — the `cli learn ROLE`
+    entry points over the `learn/` subsystem."""
+    if args.role == "status":
+        return _run_learn_status(args)  # jax-free: keep it snappy
+    cfg = _config(args) if getattr(args, "config", None) else None
+    learn_cfg = json.dumps({
+        "role": args.role,
+        "model": getattr(args, "model", None),
+        "capture": getattr(args, "capture", None),
+        "candidate": getattr(args, "candidate", None),
+        "router": getattr(args, "router", None),
+    }, sort_keys=True)
+    with _observed(args, f"learn {args.role}", config_json=learn_cfg):
+        if args.role == "run":
+            return _run_learn_loop(args, cfg)
+        if args.role == "retrain":
+            return _run_learn_retrain(args, cfg)
+        if args.role == "shadow":
+            return _run_learn_shadow(args)
+        return _run_learn_promote(args)
+
+
+def _candidate_default(model: str) -> str:
+    return os.path.abspath(model).rstrip(os.sep) + ".candidate"
+
+
+def _run_learn_loop(args, cfg) -> int:
+    from machine_learning_replications_tpu.learn.loop import LearnLoop
+    from machine_learning_replications_tpu.learn.trigger import (
+        TriggerPolicy,
+    )
+
+    loop = LearnLoop(
+        model_path=args.model,
+        capture_dir=args.capture,
+        candidate_dir=args.candidate or _candidate_default(args.model),
+        router_url=args.router,
+        policy=TriggerPolicy(
+            alert_streak=args.alert_streak,
+            cooldown_s=args.cooldown,
+            schedule_s=args.schedule,
+        ),
+        cfg=cfg,
+        thresholds=_learn_thresholds(args),
+        poll_interval_s=args.poll_interval,
+        max_rows=args.rows,
+        min_rows=args.min_rows,
+        recovery_timeout_s=args.recovery_timeout,
+        settle_timeout_s=args.settle_timeout,
+        say=lambda m: print(f"learn: {m}", file=sys.stderr),
+    )
+    import signal
+
+    stop = {"now": False}
+
+    def _stop(signum, frame):
+        stop["now"] = True
+        print("learn: stopping after the current poll ...", file=sys.stderr)
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    cycles = loop.run(
+        max_cycles=args.max_cycles, stop_check=lambda: stop["now"]
+    )
+    print(json.dumps({"cycles": cycles}, indent=1, default=str))
+    if args.max_cycles and len(cycles) < args.max_cycles:
+        return 1  # interrupted before the demanded cycles completed
+    bad = [c for c in cycles if c["outcome"] in ("failed",)]
+    return 1 if bad else 0
+
+
+def _run_learn_retrain(args, cfg) -> int:
+    from machine_learning_replications_tpu.learn import capture as capmod
+    from machine_learning_replications_tpu.learn.retrain import warm_refit
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    X17, n_bad = capmod.load_recent(args.capture, max_rows=args.rows)
+    print(
+        f"captured cohort: {X17.shape[0]} rows "
+        f"({n_bad} malformed dropped)",
+        file=sys.stderr,
+    )
+    live = orbax_io.load_model(args.model)
+    out = args.candidate or _candidate_default(args.model)
+    try:
+        _params, info = warm_refit(
+            live, X17, out, cfg=cfg,
+            resume_dir=args.resume_dir, min_rows=args.min_rows,
+        )
+    except (ValueError, TypeError) as exc:
+        raise SystemExit(f"learn retrain: {exc}")
+    print(json.dumps(info, indent=1))
+    return 0
+
+
+def _run_learn_shadow(args) -> int:
+    from machine_learning_replications_tpu.learn import capture as capmod
+    from machine_learning_replications_tpu.learn import shadow as shadowmod
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    X17, n_bad = capmod.load_recent(args.capture, max_rows=args.rows)
+    live = orbax_io.load_model(args.model)
+    candidate_dir = args.candidate or _candidate_default(args.model)
+    candidate = orbax_io.load_model(candidate_dir)
+    verdict = shadowmod.evaluate(
+        live, candidate, X17,
+        thresholds=_learn_thresholds(args),
+        candidate_version=orbax_io.checkpoint_version(candidate_dir),
+    )
+    line = json.dumps(verdict, indent=1)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"verdict written to {args.out}", file=sys.stderr)
+    return 0 if verdict["pass"] else 1
+
+
+def _run_learn_promote(args) -> int:
+    from machine_learning_replications_tpu.learn import promote as promod
+
+    candidate_dir = args.candidate or _candidate_default(args.model)
+    if args.verdict:
+        with open(args.verdict) as f:
+            verdict = json.load(f)
+    else:
+        raise SystemExit(
+            "learn promote: pass --verdict VERDICT.json (from `learn "
+            "shadow --out`) — promotion without a shadow verdict is "
+            "exactly the unguarded swap this gate exists to prevent"
+        )
+    result = promod.promote(
+        candidate_dir, args.model, args.router, verdict,
+        deploy_timeout_s=args.timeout,
+    )
+    print(json.dumps(result, indent=1))
+    return 0 if result["result"] == "promoted" else 1
+
+
+def _run_learn_status(args) -> int:
+    import urllib.error
+    import urllib.request
+
+    from machine_learning_replications_tpu.learn.trigger import (
+        poll_quality,
+        replica_urls,
+    )
+
+    base = args.router.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        urls = replica_urls(args.router)
+    except (urllib.error.URLError, OSError) as exc:
+        raise SystemExit(
+            f"learn status request to {args.router} failed: {exc}"
+        )
+    status = {
+        "router": health,
+        "capture": health.get("capture"),
+        "replicas": {url: poll_quality(url) for url in urls},
+    }
+    if args.candidate:
+        from machine_learning_replications_tpu.fleet.deploy import (
+            manifest_version,
+        )
+        from machine_learning_replications_tpu.learn.promote import (
+            REFUSED_FILE,
+            is_parked,
+        )
+
+        cand = os.path.abspath(args.candidate)
+        status["candidate"] = {
+            "path": cand,
+            "exists": os.path.isdir(cand),
+            "version": manifest_version(cand),
+            "parked": is_parked(cand),
+            "refused_file": (
+                os.path.join(cand, REFUSED_FILE) if is_parked(cand)
+                else None
+            ),
+        }
+    print(json.dumps(status, indent=1))
     return 0
 
 
@@ -1359,6 +1567,21 @@ def build_parser() -> argparse.ArgumentParser:
         "--journal", default=None,
         help="JSONL journal path (registration, rotation, deploy arc)",
     )
+    fr.add_argument(
+        "--capture", default=None, metavar="DIR",
+        help="continual-learning cohort tap (docs/CONTINUAL.md): append "
+        "every served /predict body to a bounded rotating JSONL window "
+        "in DIR — the `cli learn` retrain's data source",
+    )
+    fr.add_argument(
+        "--capture-rows-per-shard", type=int, default=4096,
+        help="capture shard rotation size (rows)",
+    )
+    fr.add_argument(
+        "--capture-max-shards", type=int, default=8,
+        help="capture shards retained (older ones are unlinked; the "
+        "window is ~rows-per-shard x max-shards recent rows)",
+    )
     fr.add_argument("--verbose", action="store_true")
     fr.set_defaults(fn=cmd_fleet)
     fd = fsub.add_parser(
@@ -1382,6 +1605,197 @@ def build_parser() -> argparse.ArgumentParser:
     )
     fs.add_argument("--router", required=True, help="router base URL")
     fs.set_defaults(fn=cmd_fleet)
+
+    ln = sub.add_parser(
+        "learn",
+        help="continual learning: drift-triggered retrain, shadow "
+        "evaluation, guarded promotion (docs/CONTINUAL.md)",
+    )
+    lsub = ln.add_subparsers(dest="role", required=True)
+
+    def add_shadow_threshold_flags(p):
+        p.add_argument(
+            "--max-divergence-mean", type=float, default=0.15,
+            help="shadow gate: max mean |p_candidate - p_live| over the "
+            "replay (a refit should recalibrate, not reinvent)",
+        )
+        p.add_argument(
+            "--max-divergence-p95", type=float, default=0.35,
+            help="shadow gate: max p95 |p_candidate - p_live|",
+        )
+        p.add_argument(
+            "--max-flip-rate", type=float, default=0.10,
+            help="shadow gate: max fraction of replay rows whose "
+            "0.5-threshold decision flips",
+        )
+        p.add_argument(
+            "--max-score-psi", type=float, default=2.0,
+            help="shadow gate: max PSI between candidate and live score "
+            "distributions over the replay",
+        )
+        p.add_argument(
+            "--max-candidate-psi", type=float, default=0.25,
+            help="shadow gate: max per-feature PSI of the replay vs the "
+            "CANDIDATE's own reference profile (the refit exists to make "
+            "this small)",
+        )
+        p.add_argument(
+            "--max-disagreement-delta", type=float, default=0.15,
+            help="shadow gate: max increase in mean pairwise ensemble "
+            "disagreement, candidate minus live",
+        )
+        p.add_argument(
+            "--shadow-min-rows", type=int, default=64,
+            help="shadow gate: minimum replay rows before a verdict may "
+            "pass (fails closed below)",
+        )
+        p.add_argument(
+            "--allow-no-profile", action="store_true",
+            help="let a candidate without its own quality reference "
+            "profile pass the gate (default: refuse — a promoted model "
+            "must ship its drift baseline)",
+        )
+
+    def add_learn_common(p, router_required: bool, cohort: bool = True):
+        p.add_argument(
+            "--model", required=True,
+            help="the LIVE checkpoint directory (the fleet's deploy "
+            "target; the candidate is judged against, and published "
+            "into, this path)",
+        )
+        p.add_argument(
+            "--candidate", default=None, metavar="DIR",
+            help="candidate checkpoint directory "
+            "(default: <model>.candidate)",
+        )
+        if cohort:  # promote applies a verdict — it never reads rows
+            p.add_argument(
+                "--capture", required=True, metavar="DIR",
+                help="the router's cohort-capture directory "
+                "(`cli fleet router --capture DIR`)",
+            )
+            p.add_argument(
+                "--rows", type=int, default=8192,
+                help="max captured rows to load (newest first)",
+            )
+            p.add_argument(
+                "--min-rows", type=int, default=200,
+                help="refuse to act on fewer captured rows",
+            )
+        if router_required:
+            p.add_argument(
+                "--router", required=True, help="fleet router base URL"
+            )
+
+    lr = lsub.add_parser(
+        "run",
+        help="the closed-loop daemon: poll fleet quality, debounce, "
+        "retrain on sustained alert, shadow-evaluate, promote through "
+        "the fleet deploy rail",
+    )
+    add_learn_common(lr, router_required=True)
+    lr.add_argument(
+        "--alert-streak", type=int, default=3,
+        help="consecutive alert polls before the trigger fires "
+        "(debounce)",
+    )
+    lr.add_argument(
+        "--cooldown", type=float, default=600.0,
+        help="seconds between trigger fires",
+    )
+    lr.add_argument(
+        "--schedule", type=float, default=None,
+        help="also fire every N seconds regardless of drift (subject to "
+        "the cooldown); default: alert-only",
+    )
+    lr.add_argument(
+        "--poll-interval", type=float, default=2.0,
+        help="seconds between quality polls",
+    )
+    lr.add_argument(
+        "--recovery-timeout", type=float, default=120.0,
+        help="seconds to wait for fleet quality to return to ok after a "
+        "promotion (the cycle's closing assertion, journaled either way)",
+    )
+    lr.add_argument(
+        "--settle-timeout", type=float, default=300.0,
+        help="post-trigger capture turnover bound: wait (up to this many "
+        "seconds) until --rows NEW rows were captured after the trigger "
+        "fired, so the refit sees only post-drift traffic — a refit on "
+        "the mixed pre/post-drift window learns a blend whose reference "
+        "profile matches neither population (0 disables)",
+    )
+    lr.add_argument(
+        "--max-cycles", type=int, default=None,
+        help="exit after N completed cycles (drills/CI; default: run "
+        "until signalled)",
+    )
+    lr.add_argument("--config", help="ExperimentConfig JSON for the refit")
+    add_shadow_threshold_flags(lr)
+    add_obs_flags(lr)
+    lr.set_defaults(fn=cmd_learn)
+
+    lt = lsub.add_parser(
+        "retrain",
+        help="one warm-start refit on the captured cohort -> a versioned "
+        "candidate checkpoint (stage-resumable)",
+    )
+    add_learn_common(lt, router_required=False)
+    lt.add_argument("--config", help="ExperimentConfig JSON for the refit")
+    lt.add_argument(
+        "--resume-dir", default=None,
+        help="StageCheckpointer directory: a preempted refit re-entered "
+        "with the same captured cohort resumes instead of restarting",
+    )
+    add_obs_flags(lt)
+    lt.set_defaults(fn=cmd_learn)
+
+    lw = lsub.add_parser(
+        "shadow",
+        help="replay the captured cohort through live + candidate and "
+        "print the machine-readable verdict (exit 1 on fail)",
+    )
+    add_learn_common(lw, router_required=False)
+    lw.add_argument(
+        "--out", default=None,
+        help="write the verdict JSON here (the input `learn promote` "
+        "requires)",
+    )
+    add_shadow_threshold_flags(lw)
+    add_obs_flags(lw)
+    lw.set_defaults(fn=cmd_learn)
+
+    lp = lsub.add_parser(
+        "promote",
+        help="apply a shadow verdict: publish the candidate into the "
+        "live path and drive the fleet's rolling deploy (pass), or park "
+        "it with a REFUSED.json (fail)",
+    )
+    add_learn_common(lp, router_required=True, cohort=False)
+    lp.add_argument(
+        "--verdict", required=False, default=None,
+        help="verdict JSON from `learn shadow --out` (required: "
+        "promotion without a verdict is the unguarded swap the gate "
+        "exists to prevent)",
+    )
+    lp.add_argument(
+        "--timeout", type=float, default=1800.0,
+        help="end-to-end rollout timeout (seconds)",
+    )
+    add_obs_flags(lp)
+    lp.set_defaults(fn=cmd_learn)
+
+    ls = lsub.add_parser(
+        "status",
+        help="fleet quality + capture-window + candidate state in one "
+        "snapshot (jax-free)",
+    )
+    ls.add_argument("--router", required=True, help="fleet router base URL")
+    ls.add_argument(
+        "--candidate", default=None,
+        help="also report this candidate dir's version/parked state",
+    )
+    ls.set_defaults(fn=cmd_learn)
 
     c = sub.add_parser(
         "score",

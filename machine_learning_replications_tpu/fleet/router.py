@@ -582,6 +582,15 @@ class _RouterApp:
         FLEET_REQUESTS.inc(outcome=outcome)
         FLEET_LATENCY.get().observe(trace.total_s)
         self.recorder.record(trace)
+        if self.handle.capture is not None and outcome == "ok":
+            # Continual-learning tap (learn.capture): every SERVED row
+            # lands in the bounded recent-cohort window. Raw bytes, no
+            # parse — validation happens once, at refit time. After the
+            # reply is written: capture latency is never client latency.
+            try:
+                self.handle.capture.append_line(job.body)
+            except Exception:
+                pass  # the data tap must never take the data path down
 
     # -- control plane --------------------------------------------------------
 
@@ -596,6 +605,13 @@ class _RouterApp:
                 "replicas_total": len(snap),
                 "replicas_ready": ready,
                 "deploy": self.handle.deploy_status,
+                # Continual-learning tap state (learn.capture), so `cli
+                # learn status` can see the refit's data window from the
+                # same probe it already polls. None when capture is off.
+                "capture": (
+                    self.handle.capture.stats()
+                    if self.handle.capture is not None else None
+                ),
                 "uptime_seconds": round(time.time() - self.started_at, 3),
             })
         elif path == "/readyz":
@@ -723,12 +739,13 @@ class RouterHandle:
     event-loop HTTP listener."""
 
     def __init__(self, registry, prober, forwarders, recorder,
-                 httpd=None) -> None:
+                 httpd=None, capture=None) -> None:
         self.registry = registry
         self.prober = prober
         self.forwarders = forwarders
         self.recorder = recorder
         self.httpd = httpd
+        self.capture = capture  # learn.capture.CohortCapture or None
         self.deploy_status: dict | None = None
         self._deploy_lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -756,6 +773,8 @@ class RouterHandle:
         self.httpd.shutdown()
         self.httpd.server_close()
         self.forwarders.close()
+        if self.capture is not None:
+            self.capture.close()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
@@ -780,6 +799,9 @@ def make_router(
     max_connections: int = 8192,
     quiet: bool = True,
     start_prober: bool = True,
+    capture_dir: str | None = None,
+    capture_rows_per_shard: int = 4096,
+    capture_max_shards: int = 8,
 ) -> RouterHandle:
     """Assemble the front-door router and bind its listener (not yet
     serving — call ``serve_forever`` or ``start_background``).
@@ -787,7 +809,12 @@ def make_router(
     dynamic members register themselves over ``POST /fleet/replicas``
     (``cli serve --register``). ``hedge_ms`` > 0 enables tail hedging;
     ``max_attempts`` bounds retry fan-out per request. ``start_prober``
-    exists for tests that drive ``prober.tick()`` by hand."""
+    exists for tests that drive ``prober.tick()`` by hand.
+    ``capture_dir`` enables the continual-learning cohort tap
+    (``learn.capture``): every served /predict body lands in a bounded
+    rotating JSONL window there (~``capture_rows_per_shard`` ×
+    ``capture_max_shards`` recent rows) — the retrain's data source
+    (docs/CONTINUAL.md)."""
     registry = ReplicaRegistry(
         fail_threshold=fail_threshold,
         recover_probes=recover_probes,
@@ -802,7 +829,20 @@ def make_router(
     recorder = reqtrace.FlightRecorder(
         capacity=trace_capacity, tail_quantile=tail_quantile
     )
-    handle = RouterHandle(registry, prober, forwarders, recorder)
+    capture = None
+    if capture_dir is not None:
+        from machine_learning_replications_tpu.learn.capture import (
+            CohortCapture,
+        )
+
+        capture = CohortCapture(
+            capture_dir,
+            rows_per_shard=capture_rows_per_shard,
+            max_shards=capture_max_shards,
+        )
+    handle = RouterHandle(
+        registry, prober, forwarders, recorder, capture=capture
+    )
     app = _RouterApp(
         handle, request_timeout_s,
         hedge_s=hedge_ms / 1000.0, max_attempts=max_attempts, quiet=quiet,

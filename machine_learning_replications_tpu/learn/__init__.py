@@ -1,0 +1,57 @@
+"""L8 — continual learning: the loop that closes detection into action
+(docs/CONTINUAL.md).
+
+PR 4 made drift *visible* (``obs.quality``: journaled ``ok → warn →
+alert`` with the offending features) and PR 9 made model swaps *safe*
+(``fleet``: versioned checkpoints, rolling ``/admin/deploy`` warm swaps,
+last-known-good rollback). Between them sat a human. This package is the
+machinery that lets the system act on its own telemetry — with the same
+refusal-first posture as everything below it:
+
+  ``capture``   bounded rotating JSONL window of served rows at the
+                router front door (the ``score``/``loadgen`` patient
+                format) — the refit's data, jax-free
+  ``trigger``   debounced, cooldown-guarded decision of WHEN to retrain
+                (sustained alert or schedule), every decision journaled
+  ``retrain``   warm-start refit of the live family on the captured
+                cohort (``fit_pipeline``/``fit_stacking`` stage
+                checkpoints — resumable), published through the atomic
+                versioned checkpoint path
+  ``shadow``    the candidate replayed against live traffic before it
+                may serve: divergence, flip rate, candidate self-quality
+                on its OWN reference profile, disagreement delta —
+                ``learn_shadow_*`` metrics + a machine-readable verdict
+  ``promote``   the gate: pass → publish into the live path + the fleet
+                router's rolling deploy; fail → candidate parked with a
+                ``REFUSED.json``, fleet untouched
+  ``loop``      the composition: one journal story from
+                ``quality_status(ok→alert)`` to
+                ``quality_status(alert→ok)``
+
+jax only where the refit/replay needs it: ``capture``, ``trigger``, and
+``promote``'s router half import none of the accelerator stack.
+"""
+
+from machine_learning_replications_tpu.learn.capture import (
+    CohortCapture,
+    load_recent,
+)
+from machine_learning_replications_tpu.learn.shadow import (
+    ShadowThresholds,
+    cohort_quality,
+    score_divergence,
+)
+from machine_learning_replications_tpu.learn.trigger import (
+    TriggerPolicy,
+    poll_quality,
+)
+
+__all__ = [
+    "CohortCapture",
+    "ShadowThresholds",
+    "TriggerPolicy",
+    "cohort_quality",
+    "load_recent",
+    "poll_quality",
+    "score_divergence",
+]

@@ -14,6 +14,8 @@ training orchestration (5-fold cross_val_predict meta-features) lives in
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.struct
 import jax.numpy as jnp
 
@@ -27,6 +29,15 @@ class StackingParams:
     gbdt: tree.TreeEnsembleParams
     logreg: linear.LinearParams      # L1 base member
     meta: linear.LinearParams        # final estimator over 3 meta-features
+    # Optional training reference profile for drift monitoring
+    # (``obs.quality.build_reference_profile`` over the contract-order
+    # ``X[n, 17]`` this family scores), the same dict-of-arrays pytree
+    # ``PipelineParams.quality`` carries. Defaults to ``None`` so
+    # pre-profile checkpoints (and the sklearn import path, which has no
+    # training matrix) restore unchanged; the continual-learning refit
+    # (``learn.retrain``) attaches one so a promoted candidate ships its
+    # own drift baseline.
+    quality: Any = None
 
 
 def member_probas(params: StackingParams, X: jnp.ndarray) -> jnp.ndarray:

@@ -73,6 +73,7 @@ value, legal for gauges under the strict validator).
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Sequence
@@ -97,6 +98,12 @@ DEFAULT_WARN_PSI = 0.1
 DEFAULT_ALERT_PSI = 0.25
 
 _STATUS_LEVEL = {"ok": 0, "warn": 1, "alert": 2}
+
+#: Status transitions remembered per monitor (the ``transitions`` ring on
+#: ``/debug/quality``): enough for a trigger daemon to debounce a
+#: sustained alert from ONE poll instead of re-reading the journal, small
+#: enough that the payload stays a snapshot, not a log.
+TRANSITION_HISTORY = 32
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +198,35 @@ def _feature_bin_indices(
     bins — one vectorized multiply/clip, the whole per-batch binning cost."""
     idx = np.floor((X - mins[None, :]) / widths[None, :] * n_bins)
     return np.clip(idx, 0, n_bins - 1).astype(np.int16)
+
+
+def profile_bin_geometry(prof: dict) -> tuple[np.ndarray, np.ndarray]:
+    """``(mins, widths)`` from a host profile's ``bin_edges``, degenerate
+    (zero-width) features floored to 1.0. ONE implementation on purpose —
+    the monitor's constructor, ``rebase``, and the shadow comparator's
+    ``cohort_quality`` (``learn.shadow``) must bin with identical
+    geometry, or the live monitor and the shadow gate would judge the
+    same rows differently."""
+    mins = prof["bin_edges"][:, 0]
+    widths = prof["bin_edges"][:, -1] - mins
+    return mins, np.where(widths > 0, widths, 1.0)
+
+
+def pairwise_disagreement(members: np.ndarray) -> np.ndarray:
+    """Per-row mean pairwise ``|p_i − p_j|`` over ensemble members
+    (``members[n, m]``) — the ensemble-agreement statistic. ONE
+    implementation on purpose: the serving monitor's window feed and the
+    shadow comparator (``learn.shadow``) must judge with identical
+    semantics, or a shadow verdict's disagreement delta would disagree
+    with the live monitor on the same inputs. ``m < 2`` yields zeros
+    (no pairs to disagree)."""
+    members = np.asarray(members, np.float64)
+    n, m = members.shape
+    pair_sum = np.zeros(n)
+    for i in range(m):
+        for j in range(i + 1, m):
+            pair_sum += np.abs(members[:, i] - members[:, j])
+    return pair_sum / max(m * (m - 1) / 2, 1)
 
 
 def _score_bin_indices(scores: np.ndarray, n_bins: int) -> np.ndarray:
@@ -376,11 +412,7 @@ class QualityMonitor:
                 f"{len(feature_names)} feature names for {F} features"
             )
         self.feature_names = tuple(str(n) for n in feature_names)
-        self._mins = self._profile["bin_edges"][:, 0]
-        self._widths = (
-            self._profile["bin_edges"][:, -1] - self._mins
-        )
-        self._widths = np.where(self._widths > 0, self._widths, 1.0)
+        self._mins, self._widths = profile_bin_geometry(self._profile)
 
         self._lock = threading.Lock()
         # Serializes whole refresh passes (copy → compute → commit): the
@@ -397,6 +429,19 @@ class QualityMonitor:
         self._rows_total = 0  # every real row ever observed
         self._last_refresh_rows = 0
         self._status = "ok"
+        # Profile generation: bumped by rebase(). Bin indices are
+        # computed outside the lock against a snapshot of the profile's
+        # edges; a batch whose generation is stale by ring-write time was
+        # binned under a superseded profile and must be dropped, not
+        # written into the fresh window.
+        self._epoch = 0
+        # Bounded status-transition history (newest last): what the
+        # continual-learning trigger daemon debounces on — K consecutive
+        # alert polls are cheap to judge when the recent arc rides the
+        # snapshot itself.
+        self._transitions: collections.deque = collections.deque(
+            maxlen=TRANSITION_HISTORY
+        )
         self._disabled_reason: str | None = None  # set by disable()
         # Last refresh's derived statistics (NaN = not computable yet).
         self._feature_psi = np.full(F, np.nan)
@@ -488,16 +533,17 @@ class QualityMonitor:
             # NaN here would turn into a garbage int16 bin index. Raise
             # loudly instead — the engine quarantines a failing feed.
             raise ValueError("observe_batch rows must be finite")
-        fidx = _feature_bin_indices(X, self._mins, self._widths, self._B)
-        sidx = _score_bin_indices(p1, self._S)
+        with self._lock:
+            # Snapshot the profile's edges + generation: a concurrent
+            # rebase() between this binning pass and the ring write below
+            # would otherwise land OLD-edge indices in the fresh window
+            # (garbage histograms under the new profile's bin_counts).
+            epoch = self._epoch
+            mins, widths, B, S = self._mins, self._widths, self._B, self._S
+        fidx = _feature_bin_indices(X, mins, widths, B)
+        sidx = _score_bin_indices(p1, S)
         if members is not None:
-            members = np.asarray(members, np.float64)
-            m = members.shape[1]
-            pair_sum = np.zeros(n)
-            for i in range(m):
-                for j in range(i + 1, m):
-                    pair_sum += np.abs(members[:, i] - members[:, j])
-            dis = pair_sum / max(m * (m - 1) / 2, 1)
+            dis = pairwise_disagreement(members)
         else:
             dis = np.full(n, np.nan)
         n_observed = n  # the true row count — rows_total must not shrink
@@ -509,6 +555,12 @@ class QualityMonitor:
             )
             n = self.window
         with self._lock:
+            if self._epoch != epoch:
+                # Rebased mid-batch: these indices were binned under the
+                # superseded profile's edges. Dropping the batch is
+                # correct — the cleared window must hold only rows judged
+                # against the new baseline.
+                return
             start = self._rows % self.window
             take = min(n, self.window - start)
             self._feat_ring[start:start + take] = fidx[:take]
@@ -605,6 +657,17 @@ class QualityMonitor:
         if new_status != old_status:
             worst_f, worst_f_psi = self._worst(f_psi, s_psi)
             self._c_transitions.inc(to=new_status)
+            record = {
+                "ts": journal.utc_now_iso(),
+                "from_status": old_status,
+                "to_status": new_status,
+                "worst_feature": worst_f,
+                "worst_psi": _round(worst_f_psi),
+                "score_psi": _round(s_psi),
+                "window_rows": n,
+            }
+            with self._lock:
+                self._transitions.append(record)
             journal.event(
                 "quality_status",
                 from_status=old_status,
@@ -655,6 +718,65 @@ class QualityMonitor:
             self._g_status.get().set(float(_STATUS_LEVEL[status]))
         return was_disabled
 
+    def rebase(self, profile: Any) -> None:
+        """Adopt a NEW reference profile in place — the continual-learning
+        promotion path (``serve.server.deploy_model``): a retrained
+        candidate fit on the *current* cohort carries its own training
+        reference, and after the warm swap the monitor must judge traffic
+        against THAT baseline, not the superseded model's. Keeping the
+        monitor object (rather than constructing a fresh one) keeps the
+        process-global gauge families and the transition counters — the
+        promotion shows up as a journaled ``alert → ok`` transition on the
+        same series, which is the whole closed-loop story.
+
+        The window rings are cleared (rows were binned under the OLD
+        profile's edges — re-judging them against new edges would be
+        statistics over garbage indices), and the drift statistics reset
+        to not-computable until ``min_rows`` fresh rows arrive. The status
+        is deliberately NOT reset: the recovery to ``ok`` must be earned
+        by post-swap traffic and journaled as a real transition, never
+        declared by the swap itself.
+
+        The new profile must describe the same feature space (same F —
+        the gauge label set is fixed at construction); bin counts may
+        differ. Raises ``ValueError`` on a mismatched profile, leaving
+        the monitor untouched.
+        """
+        prof = _as_host_profile(profile)
+        F, B = prof["bin_counts"].shape
+        if F != self._F:
+            raise ValueError(
+                f"rebase profile is {F} features wide, monitor is {self._F}"
+            )
+        with self._refresh_lock, self._lock:
+            self._epoch += 1  # invalidates in-flight old-edge binnings
+            self._profile = prof
+            self._B = int(B)
+            self._S = int(prof["score_counts"].shape[0])
+            self._mins, self._widths = profile_bin_geometry(prof)
+            self._feat_ring[:] = 0
+            self._score_ring[:] = 0
+            self._score_val_ring[:] = 0.0
+            self._dis_ring[:] = np.nan
+            self._rows = 0
+            self._last_refresh_rows = 0
+            self._last_refresh_t = float("-inf")
+            self._feature_psi = np.full(self._F, np.nan)
+            self._feature_ks = np.full(self._F, np.nan)
+            self._score_psi = float("nan")
+            self._disagreement = float("nan")
+        for name in self.feature_names:
+            self._g_feature_psi.set(float("nan"), feature=name)
+            self._g_feature_ks.set(float("nan"), feature=name)
+        self._g_score_psi.get().set(float("nan"))
+        self._g_disagreement.get().set(float("nan"))
+        self._g_window.get().set(0.0)
+        journal.event(
+            "quality_rebased",
+            reference_rows=int(prof["n_rows"]),
+            feature_bins=int(B),
+        )
+
     # -- export -------------------------------------------------------------
 
     @property
@@ -701,6 +823,7 @@ class QualityMonitor:
             s_psi = self._score_psi
             disagreement = self._disagreement
             rows_total = self._rows_total
+            transitions = [dict(t) for t in self._transitions]
         worst_f, worst_psi = self._worst(f_psi, s_psi)
         out = {
             "enabled": True,
@@ -715,6 +838,10 @@ class QualityMonitor:
             "member_disagreement": _round(_null_if_nan(disagreement)),
             "worst_feature": worst_f,
             "worst_psi": _round(worst_psi),
+            # The bounded recent-transition ring (newest last): the
+            # continual-learning trigger debounces from this one payload
+            # instead of tailing the journal (docs/CONTINUAL.md).
+            "transitions": transitions,
             "reference": {
                 "n_rows": int(self._profile["n_rows"]),
                 "feature_bins": self._B,

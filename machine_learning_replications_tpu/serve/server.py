@@ -360,6 +360,18 @@ class ServerHandle:
             )
             if quality is None and self.engine.quality is not None:
                 journal.event("deploy_quality_detached", path=model_path)
+                if self.quality is not None:
+                    # The kept monitor will never be fed again — left
+                    # enabled it would serve its PRE-deploy status (e.g.
+                    # a frozen 'alert') forever, which an unattended
+                    # continual-learning daemon would read as "the
+                    # promotion never recovered" and retrain in a loop.
+                    # Disabled, /debug/quality says so and the trigger
+                    # treats this replica as non-voting.
+                    self.quality.disable(
+                        "detached by deploy: the new checkpoint's input "
+                        "space does not match the reference profile"
+                    )
 
             def factory():
                 eng = BucketedPredictEngine(
@@ -388,6 +400,37 @@ class ServerHandle:
             if new_scorer is not None:
                 self.host.swap_scorer(new_scorer)
             self.live["params"] = params
+            if quality is not None and self.quality is not None:
+                # Continual-learning rebase (docs/CONTINUAL.md): when the
+                # new checkpoint ships its OWN reference profile (a
+                # retrained candidate fit on the shifted cohort), the
+                # kept monitor must judge traffic against THAT baseline
+                # — keeping the superseded model's profile would hold
+                # the fleet in alert forever on exactly the traffic the
+                # refit was promoted to match. Same-width is guaranteed
+                # here (_same_input_space passed); the recovery to ok is
+                # earned by post-swap traffic, journaled as a real
+                # quality_status transition. A profile-less checkpoint
+                # keeps the existing baseline unchanged, as before.
+                new_profile = getattr(params, "quality", None)
+                if new_profile is not None:
+                    try:
+                        self.quality.rebase(new_profile)
+                    except Exception as exc:
+                        # The engine swap above already committed — the
+                        # replica IS serving the new version. Raising
+                        # here would report a 'failed' deploy for a
+                        # model that is live (the rollback rail would
+                        # then reason from wrong state). A profile the
+                        # monitor can't adopt detaches monitoring
+                        # instead, loudly, on every surface.
+                        journal.event(
+                            "deploy_quality_detached", path=model_path,
+                            error=str(exc),
+                        )
+                        self.quality.disable(
+                            f"rebase failed after deploy: {exc}"
+                        )
             self.model_version = info["version"]
             if info["version"] is not None:
                 MODEL_VERSION.get().set(float(info["version"]))

@@ -1,0 +1,904 @@
+"""Continual learning (learn/): shadow comparator golden values, verdict
+thresholds both sides, capture-buffer rotation/bounds, trigger
+debounce/cooldown/schedule, quality transition ring + rebase, promotion
+park/refuse, and the warm-refit → shadow → gate arc on a real (small)
+ensemble.
+
+The comparator math tests pin ``score_divergence``/``cohort_quality``/
+``mean_disagreement`` to values computable by hand — everything
+downstream of them (gauges, verdict, journal) is formatting, so these
+goldens are the shadow contract's spec (docs/CONTINUAL.md)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.learn import capture as capturemod
+from machine_learning_replications_tpu.learn import retrain as retrainmod  # noqa: F401 — registers learn_retrain_* families
+from machine_learning_replications_tpu.learn import promote as promotemod
+from machine_learning_replications_tpu.learn import shadow as shadowmod
+from machine_learning_replications_tpu.learn import trigger as triggermod
+from machine_learning_replications_tpu.obs import journal, quality
+from machine_learning_replications_tpu.obs.registry import (
+    REGISTRY,
+    MetricsRegistry,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+try:
+    import validate_metrics
+finally:
+    sys.path.pop(0)
+
+
+def _journaled(tmp_path, fn):
+    """Run ``fn`` under a fresh journal; return its parsed events."""
+    path = tmp_path / "journal.jsonl"
+    jrn = journal.RunJournal(path, command="test")
+    journal.set_journal(jrn)
+    try:
+        fn()
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+    return [json.loads(line) for line in open(path)]
+
+
+# ---------------------------------------------------------------------------
+# comparator math: golden values
+# ---------------------------------------------------------------------------
+
+
+def test_score_divergence_identical_streams_is_zero():
+    p = np.linspace(0.05, 0.95, 200)
+    d = shadowmod.score_divergence(p, p.copy())
+    assert d["rows"] == 200
+    assert d["divergence_mean"] == 0.0
+    assert d["divergence_p95"] == 0.0
+    assert d["divergence_max"] == 0.0
+    assert d["flip_rate"] == 0.0
+    assert d["score_psi"] == 0.0
+
+
+def test_score_divergence_known_shift_golden():
+    """A constant +0.1 shift: mean/p95/max all exactly 0.1, the flip rate
+    counts exactly the rows the shift carries across 0.5, and the score
+    PSI equals the standalone ``quality.psi`` oracle on the same bins."""
+    p_live = np.array([0.10, 0.30, 0.45, 0.48, 0.60, 0.80])
+    p_cand = p_live + 0.1
+    d = shadowmod.score_divergence(p_live, p_cand)
+    assert d["divergence_mean"] == pytest.approx(0.1)
+    assert d["divergence_p95"] == pytest.approx(0.1)
+    assert d["divergence_max"] == pytest.approx(0.1)
+    # rows at 0.45 and 0.48 cross the 0.5 operating point: 2 of 6
+    assert d["flip_rate"] == pytest.approx(2 / 6)
+    bins = quality.DEFAULT_SCORE_BINS
+    live_c = np.bincount(
+        quality._score_bin_indices(p_live, bins), minlength=bins
+    )
+    cand_c = np.bincount(
+        quality._score_bin_indices(p_cand, bins), minlength=bins
+    )
+    assert d["score_psi"] == pytest.approx(quality.psi(live_c, cand_c))
+
+
+def test_score_divergence_edge_cases():
+    empty = shadowmod.score_divergence(np.zeros(0), np.zeros(0))
+    assert empty["rows"] == 0
+    # strict JSON: not-computable is None, never NaN
+    assert all(
+        empty[k] is None for k in (
+            "divergence_mean", "divergence_p95", "divergence_max",
+            "flip_rate", "score_psi",
+        )
+    )
+    json.dumps(empty, allow_nan=False)
+    with pytest.raises(ValueError, match="differ in length"):
+        shadowmod.score_divergence(np.zeros(3), np.zeros(4))
+    with pytest.raises(ValueError, match="finite"):
+        shadowmod.score_divergence(
+            np.array([0.1, np.nan]), np.array([0.1, 0.2])
+        )
+
+
+def test_mean_disagreement_golden():
+    # two members, constant gap 0.2 → mean pairwise disagreement 0.2
+    m = np.column_stack([np.full(10, 0.4), np.full(10, 0.6)])
+    assert shadowmod.mean_disagreement(m) == pytest.approx(0.2)
+    # three members at 0.2/0.4/0.8: pairs |.2|,|.6|,|.4| → mean 0.4
+    m3 = np.tile(np.array([0.2, 0.4, 0.8]), (5, 1))
+    assert shadowmod.mean_disagreement(m3) == pytest.approx(0.4)
+    assert shadowmod.mean_disagreement(None) is None
+    assert shadowmod.mean_disagreement(np.zeros((5, 1))) is None
+    assert shadowmod.mean_disagreement(np.zeros((0, 3))) is None
+
+
+def test_cohort_quality_judges_against_the_given_profile():
+    rng = np.random.default_rng(11)
+    ref = rng.normal(size=(4000, 3))
+    prof = quality.build_reference_profile(
+        ref, np.full(4000, 0.5)
+    )
+    same = shadowmod.cohort_quality(prof, rng.normal(size=(2000, 3)))
+    assert same["status"] == "ok"
+    assert same["worst_psi"] < quality.DEFAULT_WARN_PSI
+    shifted = rng.normal(size=(2000, 3))
+    shifted[:, 1] += 3.0
+    drifted = shadowmod.cohort_quality(prof, shifted)
+    assert drifted["status"] == "alert"
+    assert drifted["worst_feature_index"] == 1
+    assert drifted["worst_psi"] > quality.DEFAULT_ALERT_PSI
+    with pytest.raises(ValueError, match="describes 3 features"):
+        shadowmod.cohort_quality(prof, np.zeros((10, 4)))
+    with pytest.raises(ValueError, match="finite"):
+        shadowmod.cohort_quality(prof, np.full((10, 3), np.nan))
+
+
+# ---------------------------------------------------------------------------
+# verdict thresholds, both sides
+# ---------------------------------------------------------------------------
+
+
+def _stats(**overrides):
+    base = {
+        "rows": 500,
+        "divergence_mean": 0.05,
+        "divergence_p95": 0.10,
+        "divergence_max": 0.20,
+        "flip_rate": 0.02,
+        "score_psi": 0.5,
+        "disagreement_delta": 0.01,
+        "candidate_quality": {"status": "ok", "worst_psi": 0.05,
+                              "rows": 500},
+    }
+    base.update(overrides)
+    return base
+
+
+def test_judge_passes_below_every_threshold():
+    v = shadowmod.judge(_stats(), shadowmod.ShadowThresholds())
+    assert v["pass"] and v["reasons"] == []
+
+
+@pytest.mark.parametrize("key,bound_attr", [
+    ("divergence_mean", "max_divergence_mean"),
+    ("divergence_p95", "max_divergence_p95"),
+    ("flip_rate", "max_flip_rate"),
+    ("score_psi", "max_score_psi"),
+    ("disagreement_delta", "max_disagreement_delta"),
+])
+def test_judge_each_threshold_fails_just_above_passes_at(key, bound_attr):
+    th = shadowmod.ShadowThresholds()
+    bound = getattr(th, bound_attr)
+    at = shadowmod.judge(_stats(**{key: bound}), th)
+    assert at["pass"], f"{key} == bound must pass: {at['reasons']}"
+    over = shadowmod.judge(_stats(**{key: bound + 1e-6}), th)
+    assert not over["pass"]
+    assert any(key in r for r in over["reasons"])
+
+
+def test_judge_fails_closed_on_missing_evidence():
+    th = shadowmod.ShadowThresholds()
+    few = shadowmod.judge(_stats(rows=th.min_rows - 1), th)
+    assert not few["pass"] and "min_rows" in few["reasons"][0]
+    noprof = shadowmod.judge(_stats(candidate_quality=None), th)
+    assert not noprof["pass"]
+    assert "no quality reference profile" in noprof["reasons"][0]
+    permissive = shadowmod.ShadowThresholds(require_candidate_profile=False)
+    assert shadowmod.judge(_stats(candidate_quality=None), permissive)["pass"]
+    bad_self = shadowmod.judge(
+        _stats(candidate_quality={"status": "alert", "worst_psi": 0.9,
+                                  "rows": 500}),
+        th,
+    )
+    assert not bad_self["pass"]
+    assert "candidate self-quality" in bad_self["reasons"][0]
+
+
+def test_judge_verdict_is_strict_json():
+    stats = _stats(divergence_mean=float("nan"))
+    # NaN sneaking into a stats block must land as null in the verdict
+    v = shadowmod.judge(stats, shadowmod.ShadowThresholds())
+    json.dumps(v, allow_nan=False)
+    assert v["stats"]["divergence_mean"] is None
+
+
+def test_shadow_gauges_validator_clean_in_all_states():
+    """The learn_shadow_* families must render a strict-validator-clean
+    page both while holding the NaN "no data" value and after an export;
+    the JSON snapshot renders those NaNs as null."""
+    page = REGISTRY.render_prometheus()
+    assert validate_metrics.validate(page) == []
+    for name in (
+        "learn_shadow_divergence_mean", "learn_shadow_flip_rate",
+        "learn_shadow_score_psi", "learn_shadow_candidate_worst_psi",
+        "learn_shadow_rows", "learn_shadow_evaluations_total",
+        "learn_trigger_total", "learn_capture_rows_total",
+        "learn_promotions_total", "learn_retrain_total",
+    ):
+        assert name in page, f"{name} missing from scrape"
+    json.dumps(REGISTRY.snapshot(), allow_nan=False)
+    # export a no-data stats block (all None → NaN gauges), then a real one
+    shadowmod._export({"rows": 0})
+    assert validate_metrics.validate(REGISTRY.render_prometheus()) == []
+    assert REGISTRY.snapshot()["learn_shadow_divergence_mean"] is None
+    shadowmod._export(_stats())
+    page = REGISTRY.render_prometheus()
+    assert validate_metrics.validate(page) == []
+    snap = REGISTRY.snapshot()
+    assert snap["learn_shadow_divergence_mean"] == pytest.approx(0.05)
+    assert snap["learn_shadow_candidate_status"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# capture buffer
+# ---------------------------------------------------------------------------
+
+
+def _patient_line(**overrides) -> bytes:
+    from machine_learning_replications_tpu.data.examples import (
+        EXAMPLE_PATIENT,
+    )
+
+    p = dict(EXAMPLE_PATIENT)
+    p.update(overrides)
+    return json.dumps(p).encode()
+
+
+def test_capture_rotates_and_bounds_the_window(tmp_path):
+    cap = capturemod.CohortCapture(
+        tmp_path, rows_per_shard=4, max_shards=2
+    )
+    for i in range(20):
+        cap.append_line(_patient_line(Max_Wall_Thick=40 + i))
+    stats = cap.stats()
+    # 20 rows over 4-row shards = 5 shards; only the newest 2 retained
+    assert stats["shards"] == 2
+    assert stats["rows_appended"] == 20
+    assert stats["rows_retained"] == 8
+    on_disk = sorted(os.listdir(tmp_path))
+    assert on_disk == ["cohort-00003.jsonl", "cohort-00004.jsonl"]
+    cap.close()
+    # a restarted capture resumes the sequence instead of overwriting
+    cap2 = capturemod.CohortCapture(
+        tmp_path, rows_per_shard=4, max_shards=2
+    )
+    cap2.append_line(_patient_line(Max_Wall_Thick=99))
+    assert "cohort-00005.jsonl" in os.listdir(tmp_path)
+    cap2.close()
+
+
+def test_capture_normalizes_and_skips_empty_bodies(tmp_path):
+    cap = capturemod.CohortCapture(tmp_path, rows_per_shard=10)
+    cap.append_line(b'{"a": 1,\r\n "b": 2}')  # newline inside one body
+    cap.append_line(b"")
+    cap.append_line("   ")
+    cap.append_line({"c": 3})
+    cap.close()
+    lines = open(tmp_path / "cohort-00000.jsonl", "rb").read().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[0]) == {"a": 1, "b": 2}
+    assert json.loads(lines[1]) == {"c": 3}
+
+
+def test_load_recent_newest_rows_oldest_first_with_quarantine(tmp_path):
+    cap = capturemod.CohortCapture(tmp_path, rows_per_shard=8)
+    ages = list(range(30, 50))
+    for age in ages:
+        cap.append_line(_patient_line(Max_Wall_Thick=age))
+    cap.append_line(b'{"not": "a patient"}')
+    cap.append_line(b"garbage {{{")
+    cap.close()
+    X, n_bad = capturemod.load_recent(tmp_path, max_rows=10)
+    assert n_bad == 2
+    age_col = list(
+        json.loads(_patient_line().decode()).keys()
+    ).index("Max_Wall_Thick")
+    # the row budget covers the newest 10 captured LINES (2 of which are
+    # the malformed tail, dropped + counted), restored oldest-first
+    assert list(X[:, age_col]) == [float(a) for a in ages[-8:]]
+    with pytest.raises(ValueError, match="max_rows"):
+        capturemod.load_recent(tmp_path, max_rows=0)
+
+
+def test_capture_validates_construction(tmp_path):
+    with pytest.raises(ValueError):
+        capturemod.CohortCapture(tmp_path, rows_per_shard=0)
+    with pytest.raises(ValueError):
+        capturemod.CohortCapture(tmp_path, max_shards=0)
+
+
+# ---------------------------------------------------------------------------
+# trigger policy
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _poll(status, url="http://r1", psi=0.5, feature="Syncope"):
+    return {
+        "url": url, "ok": status is not None, "status": status,
+        "worst_feature": feature, "worst_psi": psi,
+        "transitions": [],
+    }
+
+
+def test_trigger_debounce_then_fire_then_cooldown(tmp_path):
+    clk = _Clock()
+    policy = triggermod.TriggerPolicy(
+        alert_streak=3, cooldown_s=60.0, clock=clk
+    )
+    decisions = []
+
+    def drive():
+        for _ in range(2):
+            decisions.append(policy.observe([_poll("alert", psi=2.0)]))
+            clk.t += 1
+        decisions.append(policy.observe([_poll("alert", psi=2.5)]))
+        clk.t += 1
+        # immediately alert again: suppressed by cooldown even at streak
+        for _ in range(3):
+            decisions.append(policy.observe([_poll("alert")]))
+            clk.t += 1
+        # past the cooldown the streak has rebuilt → fires again
+        clk.t += 60
+        decisions.append(policy.observe([_poll("alert", psi=3.0)]))
+
+    events = _journaled(tmp_path, drive)
+    assert decisions[0] is None and decisions[1] is None
+    assert decisions[2] is not None
+    assert decisions[2]["reason"] == "alert"
+    assert decisions[2]["worst_feature"] == "Syncope"
+    assert decisions[2]["worst_psi"] == 2.5
+    assert decisions[3] is None and decisions[4] is None
+    # streak rebuilt to 3 inside the cooldown → suppressed_cooldown
+    assert decisions[5] is None
+    assert decisions[6] is not None and decisions[6]["worst_psi"] == 3.0
+    kinds = [
+        (e["fired"], e.get("suppressed_by"))
+        for e in events if e["kind"] == "learn_trigger"
+    ]
+    # every decision journaled: 2 debounce, fire, 2 debounce, cooldown, fire
+    assert kinds == [
+        (False, "debounce"), (False, "debounce"), (True, None),
+        (False, "debounce"), (False, "debounce"), (False, "cooldown"),
+        (True, None),
+    ]
+
+
+def test_trigger_streak_resets_on_clean_poll():
+    clk = _Clock()
+    policy = triggermod.TriggerPolicy(alert_streak=2, cooldown_s=0,
+                                      clock=clk)
+    assert policy.observe([_poll("alert")]) is None
+    assert policy.observe([_poll("ok")]) is None  # reset
+    assert policy.observe([_poll("alert")]) is None  # streak back to 1
+    assert policy.observe([_poll("alert")]) is not None
+    # an unreachable fleet neither advances nor resets the streak
+    policy2 = triggermod.TriggerPolicy(alert_streak=2, cooldown_s=0,
+                                       clock=clk)
+    assert policy2.observe([_poll("alert")]) is None
+    assert policy2.observe([_poll(None)]) is None  # unreachable
+    assert policy2.observe([_poll("alert")]) is not None
+
+
+def test_trigger_schedule_fires_without_drift(tmp_path):
+    clk = _Clock()
+    policy = triggermod.TriggerPolicy(
+        alert_streak=2, cooldown_s=30.0, schedule_s=100.0, clock=clk
+    )
+
+    fired = []
+
+    def drive():
+        fired.append(policy.observe([_poll("ok")]))
+        clk.t += 99
+        fired.append(policy.observe([_poll("ok")]))
+        clk.t += 2
+        fired.append(policy.observe([_poll("ok")]))
+        # next schedule anchor is the last fire; cooldown also applies
+        clk.t += 20
+        fired.append(policy.observe([_poll("ok")]))
+        clk.t += 81
+        fired.append(policy.observe([_poll("ok")]))
+
+    events = _journaled(tmp_path, drive)
+    assert fired[0] is None and fired[1] is None
+    assert fired[2] is not None and fired[2]["reason"] == "schedule"
+    assert fired[3] is None
+    assert fired[4] is not None and fired[4]["reason"] == "schedule"
+    journaled = [e for e in events if e["kind"] == "learn_trigger"]
+    assert [e["reason"] for e in journaled if e["fired"]] == [
+        "schedule", "schedule",
+    ]
+
+
+def test_trigger_policy_validates_construction():
+    with pytest.raises(ValueError):
+        triggermod.TriggerPolicy(alert_streak=0)
+    with pytest.raises(ValueError):
+        triggermod.TriggerPolicy(cooldown_s=-1)
+    with pytest.raises(ValueError):
+        triggermod.TriggerPolicy(schedule_s=0)
+
+
+# ---------------------------------------------------------------------------
+# quality transition ring + rebase (the satellite + the promotion rebase)
+# ---------------------------------------------------------------------------
+
+
+def _stable_monitor(n_ref=4000, window=1024, **kw):
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(n_ref, 17))
+    scores = 1.0 / (1.0 + np.exp(-X @ rng.normal(size=17) / 4.0))
+    prof = quality.build_reference_profile(
+        X, scores, (scores > 0.5).astype(float)
+    )
+    kw.setdefault("refresh_interval_s", 0.0)
+    mon = quality.QualityMonitor(
+        prof, window=window, registry=MetricsRegistry(), **kw
+    )
+    return mon, X, scores, rng
+
+
+def test_snapshot_transition_ring_records_the_arc(tmp_path):
+    mon, X, scores, rng = _stable_monitor(window=512, min_rows=100)
+
+    def drive():
+        bad = rng.normal(size=(512, 17))
+        bad[:, 0] += 5.0
+        mon.observe_batch(bad, rng.choice(scores, size=512))
+        assert mon.status == "alert"
+        mon.observe_batch(
+            rng.normal(size=(512, 17)), rng.choice(scores, size=512)
+        )
+        assert mon.status == "ok"
+
+    _journaled(tmp_path, drive)
+    snap = mon.snapshot()
+    arcs = [(t["from_status"], t["to_status"]) for t in snap["transitions"]]
+    assert arcs == [("ok", "alert"), ("alert", "ok")]
+    first = snap["transitions"][0]
+    assert first["worst_feature"] == "Obstructive HCM"
+    assert first["worst_psi"] > quality.DEFAULT_ALERT_PSI
+    assert first["window_rows"] == 512
+    assert "ts" in first
+    json.dumps(snap, allow_nan=False)
+
+
+def test_transition_ring_is_bounded():
+    mon, X, scores, rng = _stable_monitor(window=256, min_rows=50)
+    clean = rng.normal(size=(256, 17))
+    bad = clean.copy()
+    bad[:, 3] += 5.0
+    for _ in range(quality.TRANSITION_HISTORY):
+        mon.observe_batch(bad, rng.choice(scores, size=256))
+        mon.observe_batch(clean, rng.choice(scores, size=256))
+    ring = mon.snapshot()["transitions"]
+    assert len(ring) == quality.TRANSITION_HISTORY
+    # newest-last: the final entry is the latest recovery
+    assert ring[-1]["to_status"] == "ok"
+
+
+def test_rebase_adopts_profile_and_recovery_is_earned(tmp_path):
+    """The promotion path's monitor rebase: alert under shifted traffic,
+    rebase onto a profile built FROM that shifted cohort, and the status
+    returns to ok only after fresh post-rebase traffic — journaled as a
+    real transition."""
+    mon, X, scores, rng = _stable_monitor(window=512, min_rows=100)
+    shifted = rng.normal(size=(2000, 17)) + 2.0
+
+    def drive():
+        mon.observe_batch(shifted[:512], rng.choice(scores, size=512))
+        assert mon.status == "alert"
+        new_prof = quality.build_reference_profile(
+            shifted, np.clip(rng.choice(scores, size=2000), 0, 1)
+        )
+        mon.rebase(new_prof)
+        # the rebase clears the window but does NOT declare recovery
+        assert mon.status == "alert"
+        snap = mon.snapshot()
+        assert snap["window_rows"] == 0
+        assert snap["score_psi"] is None
+        # fresh traffic matching the NEW baseline earns the recovery
+        mon.observe_batch(
+            rng.normal(size=(512, 17)) + 2.0,
+            rng.choice(scores, size=512),
+        )
+        assert mon.status == "ok"
+
+    events = _journaled(tmp_path, drive)
+    kinds = [e["kind"] for e in events]
+    assert "quality_rebased" in kinds
+    trans = [e for e in events if e["kind"] == "quality_status"]
+    assert [(e["from_status"], e["to_status"]) for e in trans] == [
+        ("ok", "alert"), ("alert", "ok"),
+    ]
+    # rebase happened between the two transitions
+    assert kinds.index("quality_rebased") > kinds.index("quality_status")
+
+
+def test_rebase_rejects_mismatched_width():
+    mon, X, scores, rng = _stable_monitor()
+    narrow = quality.build_reference_profile(
+        rng.normal(size=(500, 5)), np.full(500, 0.5)
+    )
+    with pytest.raises(ValueError, match="5 features"):
+        mon.rebase(narrow)
+    # untouched: still judging against the original 17-wide profile
+    mon.observe_batch(rng.normal(size=(512, 17)),
+                      rng.choice(scores, size=512))
+    assert mon.status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# promotion gate mechanics (jax-free half)
+# ---------------------------------------------------------------------------
+
+
+def test_park_writes_refusal_and_blocks_publish(tmp_path):
+    cand = tmp_path / "candidate"
+    cand.mkdir()
+    verdict = {"pass": False, "reasons": ["flip_rate 0.4 exceeds 0.1"]}
+
+    def drive():
+        path = promotemod.park(cand, verdict)
+        assert os.path.basename(path) == promotemod.REFUSED_FILE
+        refused = json.load(open(path))
+        assert refused["kind"] == "learn_promotion_refused"
+        assert refused["verdict"]["reasons"] == verdict["reasons"]
+
+    events = _journaled(tmp_path, drive)
+    assert promotemod.is_parked(cand)
+    refusals = [
+        e for e in events
+        if e["kind"] == "learn_promotion" and e["result"] == "refused"
+    ]
+    assert len(refusals) == 1
+    with pytest.raises(RuntimeError, match="refused"):
+        promotemod.publish_candidate(cand, tmp_path / "live")
+
+
+def test_promote_refuses_failing_verdict_without_touching_fleet(tmp_path):
+    cand = tmp_path / "cand"
+    cand.mkdir()
+    out = promotemod.promote(
+        cand, tmp_path / "live", "http://127.0.0.1:9",  # unroutable
+        {"pass": False, "reasons": ["rows below min"]},
+    )
+    assert out["result"] == "refused"
+    assert promotemod.is_parked(cand)
+    # no deploy was attempted: the unroutable router URL never mattered
+
+
+def test_promote_via_router_reads_deploy_report(tmp_path):
+    from machine_learning_replications_tpu.serve.transport import (
+        EventLoopHttpServer,
+    )
+
+    class _StubRouter:
+        def __init__(self):
+            self.bodies = []
+            self.response = {"deploy": {"result": "ok", "replicas": []}}
+            self.code = 200
+
+        def handle_request(self, req, rsp):
+            self.bodies.append(json.loads(req.body))
+            rsp.send_json(self.code, self.response)
+
+        def handle_protocol_error(self, exc, rsp):
+            rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+    stub = _StubRouter()
+    httpd = EventLoopHttpServer(("127.0.0.1", 0), stub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        report = promotemod.promote_via_router(url, "/ck/model")
+        assert report["result"] == "ok"
+        assert stub.bodies == [{"model": "/ck/model"}]
+        # an HTTP-error reply that still carries a deploy report (the
+        # 409 already-in-progress shape) is returned, not raised
+        stub.code = 409
+        stub.response = {"deploy": {"result": "failed",
+                                    "error": "in progress"}}
+        report = promotemod.promote_via_router(url, "/ck/model")
+        assert report["result"] == "failed"
+        # an HTTP error without a report is a transport failure
+        stub.code = 500
+        stub.response = {"error": "boom"}
+        with pytest.raises(RuntimeError, match="boom"):
+            promotemod.promote_via_router(url, "/ck/model")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    with pytest.raises(RuntimeError, match="failed"):
+        promotemod.promote_via_router("http://127.0.0.1:9", "/ck/model",
+                                      timeout_s=0.5)
+
+
+# ---------------------------------------------------------------------------
+# loadgen perturb-until / revert-file (the client satellite)
+# ---------------------------------------------------------------------------
+
+
+def _loadgen():
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+    return loadgen
+
+
+def test_loadgen_perturb_until_reverts_mid_run():
+    lg = _loadgen()
+    patients = [{"Age": 50.0}]
+    bodies = lg._Bodies(
+        patients, lg.parse_perturb("Age+10"), onset_frac=0.0,
+        duration=0.2, until_frac=0.5,
+    )
+    bodies.arm(time.monotonic())
+    assert json.loads(bodies.next_body())["Age"] == 60.0
+    time.sleep(0.12)
+    assert json.loads(bodies.next_body())["Age"] == 50.0
+    desc = bodies.describe()
+    assert desc["onset_index"] == 0
+    assert desc["revert_index"] == 1
+    assert desc["until_fraction"] == 0.5
+    assert desc["revert_time_s"] is not None
+    # once reverted, it stays reverted
+    assert json.loads(bodies.next_body())["Age"] == 50.0
+
+
+def test_loadgen_revert_file_ends_the_perturbation(tmp_path):
+    lg = _loadgen()
+    flag = tmp_path / "promoted.flag"
+    bodies = lg._Bodies(
+        [{"Age": 50.0}], lg.parse_perturb("Age*2"), onset_frac=0.0,
+        duration=100.0, revert_file=str(flag),
+    )
+    bodies.arm(time.monotonic())
+    assert json.loads(bodies.next_body())["Age"] == 100.0
+    flag.touch()
+    time.sleep(bodies.REVERT_POLL_S + 0.05)
+    assert json.loads(bodies.next_body())["Age"] == 50.0
+    assert bodies.describe()["revert_index"] is not None
+
+
+# ---------------------------------------------------------------------------
+# warm refit + shadow + gate on a real (small) ensemble
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_checkpoint(tmp_path_factory):
+    """A small fitted StackingParams WITH its own reference profile,
+    published as a versioned checkpoint — the continual loop's live
+    model."""
+    import jax.numpy as jnp
+
+    from machine_learning_replications_tpu.config import (
+        ExperimentConfig, GBDTConfig, SVCConfig,
+    )
+    from machine_learning_replications_tpu.data import make_cohort
+    from machine_learning_replications_tpu.data.schema import (
+        selected_indices,
+    )
+    from machine_learning_replications_tpu.models import pipeline as pl
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    X64, y, _ = make_cohort(n=400, seed=7, missing_rate=0.0)
+    X17 = np.asarray(X64[:, selected_indices()], np.float64)
+    y = np.asarray(y, np.float64)
+    cfg = ExperimentConfig(
+        gbdt=GBDTConfig(n_estimators=5),
+        svc=SVCConfig(platt_cv=2, max_iter=300),
+    )
+    ens = pl.fit_stacking(X17, y, cfg)
+    scores = pl._ensemble_scores(
+        ens, X17, chunk_rows=cfg.svc.predict_chunk_rows
+    )
+    prof = quality.build_reference_profile(X17, scores, y=y)
+    live = ens.replace(
+        quality={k: jnp.asarray(v) for k, v in prof.items()}
+    )
+    path = str(tmp_path_factory.mktemp("ck") / "live")
+    orbax_io.save_model(path, live)
+    return path, X17, cfg
+
+
+def test_warm_refit_validates_input(live_checkpoint):
+    from machine_learning_replications_tpu.learn import retrain
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    path, X17, cfg = live_checkpoint
+    live = orbax_io.load_model(path)
+    with pytest.raises(ValueError, match="min_rows"):
+        retrain.warm_refit(live, X17[:10], "/tmp/x", cfg=cfg)
+    with pytest.raises(ValueError, match=r"\[n, 17\]"):
+        retrain.warm_refit(live, X17[:, :5], "/tmp/x", cfg=cfg)
+    bad = X17.copy()
+    bad[0, 0] = np.nan
+    with pytest.raises(ValueError, match="finite"):
+        retrain.warm_refit(live, bad, "/tmp/x", cfg=cfg, min_rows=100)
+    with pytest.raises(ValueError, match="labels"):
+        retrain.warm_refit(
+            live, X17, "/tmp/x", cfg=cfg,
+            labels=np.ones(X17.shape[0]), min_rows=100,
+        )
+    with pytest.raises(ValueError, match="single-class"):
+        # a live model that decides every row the same way cannot distill
+        class _Constant:
+            pass
+
+        import unittest.mock as mock
+
+        with mock.patch.object(
+            retrain, "pseudo_labels",
+            return_value=np.zeros(X17.shape[0]),
+        ):
+            retrain.warm_refit(live, X17, "/tmp/x", cfg=cfg,
+                               min_rows=100)
+    with pytest.raises(TypeError, match="cannot warm-refit"):
+        retrain.warm_refit(object(), X17, "/tmp/x", cfg=cfg,
+                           min_rows=100)
+
+
+def test_refit_shadow_gate_arc_on_shifted_cohort(live_checkpoint,
+                                                 tmp_path):
+    """The loop's core claim, in-process: a warm refit on the shifted
+    cohort produces a candidate that (a) carries its own reference
+    profile judging the shifted rows ok, (b) passes the shadow gate
+    against the live model, and (c) is versioned; while doctored
+    thresholds refuse and park the very same candidate."""
+    from machine_learning_replications_tpu.learn import retrain
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    path, X17, cfg = live_checkpoint
+    live = orbax_io.load_model(path)
+    shifted = X17.copy()
+    shifted[:, 0] += 1.0
+    cand_dir = str(tmp_path / "cand")
+    cand, info = retrain.warm_refit(
+        live, shifted, cand_dir, cfg=cfg, min_rows=200
+    )
+    assert info["labels_source"] == "distilled"
+    assert info["version"] == 1
+    assert cand.quality is not None
+    verdict = shadowmod.evaluate(
+        live, cand, shifted, candidate_version=info["version"]
+    )
+    assert verdict["pass"], verdict["reasons"]
+    stats = verdict["stats"]
+    assert stats["rows"] == X17.shape[0]
+    assert stats["candidate_quality"]["status"] == "ok"
+    # non-trivial divergence: the refit moved with the cohort
+    assert stats["divergence_mean"] > 0.0
+    # the same candidate under an impossibly strict gate is refused
+    strict = shadowmod.ShadowThresholds(max_divergence_mean=0.0)
+    refused = shadowmod.evaluate(live, cand, shifted, thresholds=strict)
+    assert not refused["pass"]
+    promotemod.park(cand_dir, refused)
+    assert promotemod.is_parked(cand_dir)
+    with pytest.raises(RuntimeError, match="refused"):
+        promotemod.publish_candidate(cand_dir, str(tmp_path / "live2"))
+    # the candidate checkpoint itself round-trips with its profile
+    reloaded = orbax_io.load_model(cand_dir)
+    assert sorted(np.asarray(reloaded.quality["bin_counts"]).shape) == \
+        sorted(np.asarray(cand.quality["bin_counts"]).shape)
+
+
+def test_replay_scores_matches_eager_oracle(live_checkpoint):
+    from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    path, X17, _cfg = live_checkpoint
+    live = orbax_io.load_model(path)
+    p1, members, rows = shadowmod.replay_scores(live, X17[:64],
+                                                chunk_rows=16)
+    direct, direct_members = stacking.predict_proba1_with_members(
+        live, X17[:64]
+    )
+    np.testing.assert_array_equal(p1, np.asarray(direct, np.float64))
+    np.testing.assert_array_equal(
+        members, np.asarray(direct_members, np.float64)
+    )
+    np.testing.assert_array_equal(rows, X17[:64])
+
+
+def test_cli_learn_parser_roundtrip():
+    from machine_learning_replications_tpu.cli import build_parser
+
+    ap = build_parser()
+    args = ap.parse_args([
+        "learn", "run", "--model", "/ck", "--capture", "/cap",
+        "--router", "http://r", "--alert-streak", "2",
+        "--cooldown", "5", "--max-cycles", "1",
+    ])
+    assert args.role == "run" and args.alert_streak == 2
+    args = ap.parse_args([
+        "learn", "shadow", "--model", "/ck", "--capture", "/cap",
+        "--max-flip-rate", "0.2", "--out", "/tmp/v.json",
+    ])
+    assert args.role == "shadow" and args.max_flip_rate == 0.2
+    # promote applies a verdict — it must not demand the cohort flags
+    args = ap.parse_args([
+        "learn", "promote", "--model", "/ck", "--router", "http://r",
+        "--verdict", "/tmp/v.json",
+    ])
+    assert args.role == "promote" and args.verdict == "/tmp/v.json"
+    args = ap.parse_args(["learn", "status", "--router", "http://r"])
+    assert args.role == "status"
+
+
+def test_obs_report_learn_section(tmp_path):
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    j = tmp_path / "j.jsonl"
+    events = [
+        {"ts": "2026-08-03T10:00:01Z", "kind": "quality_status",
+         "from_status": "ok", "to_status": "alert",
+         "worst_feature": "Syncope", "worst_psi": 2.3,
+         "window_rows": 400},
+        {"ts": "2026-08-03T10:00:02Z", "kind": "learn_trigger",
+         "fired": True, "reason": "alert", "streak": 3,
+         "alert_streak_needed": 3, "worst_feature": "Syncope",
+         "worst_psi": 2.3},
+        {"ts": "2026-08-03T10:00:03Z", "kind": "learn_retrain_start",
+         "rows": 400},
+        {"ts": "2026-08-03T10:00:04Z", "kind": "stage_done",
+         "stage": "member_gbdt", "seconds": 0.5},
+        {"ts": "2026-08-03T10:00:05Z", "kind": "learn_retrain_done",
+         "rows": 400, "labels_source": "distilled",
+         "family": "StackingParams", "version": 2, "seconds": 4.5},
+        {"ts": "2026-08-03T10:00:06Z", "kind": "learn_shadow_verdict",
+         "passed": True, "candidate_version": 2, "rows": 400,
+         "divergence_mean": 0.12, "divergence_p95": 0.3,
+         "divergence_max": 0.4, "flip_rate": 0.03, "score_psi": 1.4,
+         "candidate_quality": {"status": "ok", "worst_psi": 0.0,
+                               "rows": 400},
+         "reasons": []},
+        {"ts": "2026-08-03T10:00:07Z", "kind": "learn_promotion",
+         "result": "promoted", "candidate": "/c", "version": 3},
+        {"ts": "2026-08-03T10:00:08Z", "kind": "quality_status",
+         "from_status": "alert", "to_status": "ok",
+         "worst_psi": 0.01, "window_rows": 400},
+        {"ts": "2026-08-03T10:00:09Z", "kind": "learn_recovery",
+         "recovered": True},
+    ]
+    with open(j, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    out = tmp_path / "report.md"
+    assert obs_report.main([
+        "--learn", "--journal", str(j), "--out", str(out),
+    ]) == 0
+    text = out.read_text()
+    assert "## Continual learning" in text
+    assert "ok → alert" in text and "alert → ok" in text
+    assert "FIRED" in text
+    assert "candidate v2" in text
+    assert "shadow verdict: PASS" in text
+    assert "promotion promoted" in text
+    assert "quality returned to ok" in text
+    assert "member_gbdt" in text

@@ -49,6 +49,15 @@ onset time, so a ``/debug/quality`` snapshot or journal
 ``quality_status`` transition can be joined against exactly when the
 distribution moved.
 
+The perturbation can also *end* mid-run — ``--perturb-until FRAC``
+reverts it at a run fraction, and ``--perturb-revert-file PATH`` reverts
+it the moment PATH appears on disk (polled cheaply, ≤4 stats/s). The
+revert index/time land in the artifact next to the onset. This is the
+continual-learning demo's client (docs/CONTINUAL.md): ONE loadgen run
+drives drift → alert → retrain → promote, the demo driver touches the
+revert file after the rolling promotion, and the same client's traffic
+then proves the promoted model reads the recovered cohort as ``ok``.
+
 Against a fleet (the front-door router or a single identity-carrying
 replica — docs/FLEET.md), the echoed ``X-Replica`` / ``X-Model-Version``
 headers are tallied into the artifact's ``fleet`` block: ok replies per
@@ -124,19 +133,34 @@ def apply_perturb(
 
 class _Bodies:
     """Per-request POST bodies: the patient cohort cycled round-robin,
-    with the perturbation switched on mid-run. ``arm(t0)`` fixes the
-    onset clock when the load loop starts; the first request issued at or
-    after onset records its index (the artifact's ``onset_index``)."""
+    with the perturbation switched on mid-run — and optionally back OFF
+    (``until_frac`` run fraction, or the appearance of ``revert_file`` on
+    disk, whichever comes first). ``arm(t0)`` fixes the onset clock when
+    the load loop starts; the first request issued at or after onset (and
+    the first after revert) records its index for the artifact."""
+
+    #: Seconds between ``revert_file`` stat() checks — an os.stat per
+    #: request would tax the client at four-digit qps for a signal that
+    #: only has to land within a fraction of a second.
+    REVERT_POLL_S = 0.25
 
     def __init__(self, patients: list[dict], perturb_ops, onset_frac,
-                 duration: float) -> None:
+                 duration: float, until_frac: float | None = None,
+                 revert_file: str | None = None) -> None:
         self.patients = patients
         self.ops = perturb_ops
         self.onset_frac = onset_frac
+        self.until_frac = until_frac
+        self.revert_file = revert_file
         self.duration = duration
         self.onset_at: float | None = None  # monotonic; None = no perturb
         self.onset_index: int | None = None
         self.onset_time_s: float | None = None
+        self.revert_at: float | None = None  # monotonic; None = no revert
+        self.revert_index: int | None = None
+        self.revert_time_s: float | None = None
+        self._reverted = False
+        self._next_file_check = 0.0
         self._t0 = 0.0
         self._lock = threading.Lock()
         self._i = 0
@@ -154,6 +178,18 @@ class _Bodies:
         self._t0 = t0
         if self.ops:
             self.onset_at = t0 + self.onset_frac * self.duration
+            if self.until_frac is not None:
+                self.revert_at = t0 + self.until_frac * self.duration
+
+    def _revert_due_locked(self, now: float) -> bool:
+        if self._reverted:
+            return True
+        if self.revert_at is not None and now >= self.revert_at:
+            return True
+        if self.revert_file is not None and now >= self._next_file_check:
+            self._next_file_check = now + self.REVERT_POLL_S
+            return os.path.exists(self.revert_file)
+        return False
 
     def next_body(self) -> bytes:
         now = time.monotonic()
@@ -164,6 +200,14 @@ class _Bodies:
             if active and self.onset_index is None:
                 self.onset_index = i
                 self.onset_time_s = now - self._t0
+            # Revert is checked only once the perturbation is live: a
+            # revert signal can't pre-empt an onset that hasn't happened.
+            if active and self._revert_due_locked(now):
+                if not self._reverted:
+                    self._reverted = True
+                    self.revert_index = i
+                    self.revert_time_s = now - self._t0
+                active = False
         p = self.patients[i % len(self.patients)]
         if active:
             p = apply_perturb(p, self.ops)
@@ -181,6 +225,13 @@ class _Bodies:
             "onset_time_s": (
                 None if self.onset_time_s is None
                 else round(self.onset_time_s, 3)
+            ),
+            "until_fraction": self.until_frac,
+            "revert_file": self.revert_file,
+            "revert_index": self.revert_index,
+            "revert_time_s": (
+                None if self.revert_time_s is None
+                else round(self.revert_time_s, 3)
             ),
         }
 
@@ -959,6 +1010,18 @@ def main(argv=None) -> int:
         "(default 0.5; 0 perturbs from the first request)",
     )
     ap.add_argument(
+        "--perturb-until", type=float, default=None, metavar="FRAC",
+        help="fraction of the run at which --perturb reverts (default: "
+        "never) — one run drives a full drift-then-recovery arc",
+    )
+    ap.add_argument(
+        "--perturb-revert-file", default=None, metavar="PATH",
+        help="revert --perturb as soon as PATH exists (polled, <=4 "
+        "stats/s) — an external driver (e.g. the continual-learning "
+        "demo, after its rolling promotion) ends the drift under the "
+        "same running client; revert index/time land in the artifact",
+    )
+    ap.add_argument(
         "--retries", type=int, default=0,
         help="max retries per request on a 503 shed (capped exponential "
         "backoff + jitter, honoring Retry-After); retry counts and "
@@ -980,6 +1043,11 @@ def main(argv=None) -> int:
         ap.error("--patient and --patients are mutually exclusive")
     if not 0.0 <= args.perturb_at <= 1.0:
         ap.error("--perturb-at must be in [0, 1]")
+    if args.perturb_until is not None:
+        if not 0.0 <= args.perturb_until <= 1.0:
+            ap.error("--perturb-until must be in [0, 1]")
+        if args.perturb_until <= args.perturb_at:
+            ap.error("--perturb-until must be after --perturb-at")
     if args.retries and args.mode == "open":
         # A generator that backs off is no longer offering a fixed rate:
         # retry sleeps would hold in-flight slots and silently throttle
@@ -1021,7 +1089,11 @@ def main(argv=None) -> int:
         patients = [dict(EXAMPLE_PATIENT)]
         patients_src = "example"
     perturb_ops = parse_perturb(args.perturb) if args.perturb else []
-    bodies = _Bodies(patients, perturb_ops, args.perturb_at, args.duration)
+    bodies = _Bodies(
+        patients, perturb_ops, args.perturb_at, args.duration,
+        until_frac=args.perturb_until,
+        revert_file=args.perturb_revert_file,
+    )
 
     retry = _RetryPolicy(
         retries=args.retries, base_ms=args.retry_base_ms,

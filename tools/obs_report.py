@@ -38,6 +38,15 @@ Sources (mix live and file freely; stdlib only):
                    (fetches /healthz, /metrics?format=json,
                    /fleet/replicas, /debug/requests), or join a saved
                    --metrics snapshot with the router's --journal
+  --learn          render the "Continual learning" section
+                   (docs/CONTINUAL.md): trigger decisions, refit stage
+                   timings, the shadow verdict, the promotion/deploy
+                   arc, and the bracketing quality_status transitions.
+                   ``--journal`` is repeatable — the arc spans the
+                   router's, the replicas', and the learn daemon's
+                   journals, merged by timestamp; ``--bench`` joins the
+                   driving loadgen run's perturbation onset/revert.
+                   Composes with --fleet (arc first, fleet detail after)
   --out PATH       write the report there (default: stdout)
 
 Example:
@@ -494,6 +503,195 @@ def _section_fleet(
         rep.table(("when", "model", "deploy arc"), rows)
 
 
+def _section_learn(rep: Report, events: list[dict], bench: dict | None):
+    """The "Continual learning" section: the closed loop's one joined
+    story (docs/CONTINUAL.md) — trigger decisions, the refit's stage
+    timings, the shadow verdict, the promotion/deploy arc, and the
+    quality transitions that bracket it (ok→alert before, alert→ok
+    after), optionally joined against the driving loadgen artifact's
+    perturbation onset/revert."""
+    rep.h("Continual learning")
+    if not events:
+        rep.kv("continual learning", "unavailable (no --journal)")
+        return
+
+    perturb = (bench or {}).get("perturb")
+    if perturb:
+        rep.kv(
+            "driving perturbation",
+            f"{perturb.get('spec')} (onset {perturb.get('onset_time_s')}s"
+            + (
+                f", reverted {perturb.get('revert_time_s')}s"
+                if perturb.get("revert_time_s") is not None else ""
+            )
+            + ")",
+        )
+
+    transitions = [e for e in events if e.get("kind") == "quality_status"]
+    if transitions:
+        rep.table(
+            ("when", "transition", "worst feature", "psi", "window rows"),
+            [
+                (
+                    e.get("ts"),
+                    f"{e.get('from_status')} → {e.get('to_status')}",
+                    e.get("worst_feature"), _fmt(e.get("worst_psi")),
+                    e.get("window_rows"),
+                )
+                for e in transitions
+            ],
+        )
+        rep.lines.append("")
+
+    triggers = [e for e in events if e.get("kind") == "learn_trigger"]
+    if triggers:
+        rep.table(
+            ("when", "decision", "reason", "streak", "worst feature",
+             "psi"),
+            [
+                (
+                    e.get("ts"),
+                    "FIRED" if e.get("fired")
+                    else f"suppressed ({e.get('suppressed_by')})",
+                    e.get("reason"),
+                    f"{e.get('streak')}/{e.get('alert_streak_needed')}",
+                    e.get("worst_feature"), _fmt(e.get("worst_psi")),
+                )
+                for e in triggers
+            ],
+        )
+        rep.lines.append("")
+
+    retrain_done = [
+        e for e in events
+        if e.get("kind") in ("learn_retrain_done", "learn_retrain_failed")
+    ]
+    for e in retrain_done:
+        if e["kind"] == "learn_retrain_done":
+            rep.kv(
+                "refit",
+                f"{e.get('rows')} rows ({e.get('labels_source')} labels) "
+                f"→ {e.get('family')} candidate v{e.get('version')} "
+                f"in {e.get('seconds')}s",
+            )
+        else:
+            rep.kv(
+                "refit FAILED",
+                f"{e.get('error')} after {e.get('seconds')}s",
+            )
+    starts = [e for e in events if e.get("kind") == "learn_retrain_start"]
+    if starts:
+        # Stage timings between the first retrain_start and its end mark
+        # — the StageCheckpointer arc the refit rides.
+        t0 = starts[0].get("ts") or ""
+        ends = sorted(e.get("ts") or "" for e in retrain_done)
+        t1 = ends[0] if ends else None
+        stages = [
+            e for e in events
+            if e.get("kind") == "stage_done"
+            and t0 <= (e.get("ts") or "")
+            and (t1 is None or (e.get("ts") or "") <= t1)
+        ]
+        if stages:
+            rep.table(
+                ("refit stage", "seconds"),
+                [(e.get("stage"), _fmt(e.get("seconds"))) for e in stages],
+            )
+            rep.lines.append("")
+
+    verdicts = [e for e in events if e.get("kind") == "learn_shadow_verdict"]
+    for e in verdicts:
+        rep.kv(
+            "shadow verdict",
+            ("PASS" if e.get("passed") else "FAIL")
+            + f" (candidate v{e.get('candidate_version')}, "
+            f"{e.get('rows')} replay rows)",
+        )
+        rep.kv(
+            "  divergence",
+            f"mean {_fmt(e.get('divergence_mean'))}, "
+            f"p95 {_fmt(e.get('divergence_p95'))}, "
+            f"max {_fmt(e.get('divergence_max'))}, "
+            f"flip rate {_fmt(e.get('flip_rate'))}, "
+            f"score PSI {_fmt(e.get('score_psi'))}",
+        )
+        cq = e.get("candidate_quality")
+        if cq:
+            rep.kv(
+                "  candidate self-quality",
+                f"{cq.get('status')} (worst PSI {_fmt(cq.get('worst_psi'))} "
+                f"over {cq.get('rows')} rows)",
+            )
+        if e.get("reasons"):
+            rep.kv("  refusal reasons", "; ".join(e["reasons"]))
+
+    promotions = [e for e in events if e.get("kind") == "learn_promotion"]
+    for e in promotions:
+        detail = f"candidate {e.get('candidate')}"
+        if e.get("version") is not None:
+            detail += f" → live v{e.get('version')}"
+        if e.get("reasons"):
+            detail += f" — {'; '.join(e['reasons'])}"
+        if e.get("deploy_error"):
+            detail += f" — {e['deploy_error']}"
+        rep.kv(f"promotion {e.get('result')}", detail)
+
+    deploys = [
+        e for e in events
+        if e.get("kind") in ("fleet_deploy_start", "fleet_deploy_replica",
+                             "fleet_deploy_done")
+    ]
+    if deploys:
+        rep.lines.append("")
+        rows = []
+        for e in deploys:
+            if e["kind"] == "fleet_deploy_start":
+                what = (
+                    f"start → version {e.get('target_version')} "
+                    f"over {len(e.get('replicas') or [])} replicas"
+                )
+            elif e["kind"] == "fleet_deploy_replica":
+                what = (
+                    f"replica {e.get('replica')}: {e.get('result')} "
+                    f"(version {e.get('achieved_version')}"
+                    + (", ROLLED BACK" if e.get("rolled_back") else "")
+                    + ")"
+                )
+            else:
+                what = (
+                    f"done: {e.get('result')}"
+                    + (f" — {e.get('error')}" if e.get("error") else "")
+                )
+            rows.append((e.get("ts"), what))
+        rep.table(("when", "deploy arc"), rows)
+        rep.lines.append("")
+
+    rebases = [e for e in events if e.get("kind") == "quality_rebased"]
+    for e in rebases:
+        rep.kv(
+            "quality rebased",
+            f"{e.get('ts')}: monitor adopted the promoted model's "
+            f"reference ({e.get('reference_rows')} training rows)",
+        )
+    recoveries = [e for e in events if e.get("kind") == "learn_recovery"]
+    for e in recoveries:
+        rep.kv(
+            "recovery",
+            ("quality returned to ok" if e.get("recovered")
+             else "quality did NOT recover in time")
+            + f" ({e.get('ts')})",
+        )
+    cycles = [e for e in events if e.get("kind") == "learn_cycle_done"]
+    for e in cycles:
+        rep.kv(
+            "cycle",
+            f"{e.get('outcome')} (v{e.get('from_version')} → "
+            f"v{e.get('to_version')}) in {e.get('seconds')}s",
+        )
+    if not any((triggers, retrain_done, verdicts, promotions, cycles)):
+        rep.kv("continual learning", "no learn_* events in the journal")
+
+
 def _phase_summary(trace: dict) -> str:
     phases = trace.get("phases") or {}
     parts = []
@@ -609,7 +807,12 @@ def _section_join(rep: Report, bench: dict | None, requests: dict | None):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--url", help="live server base URL")
-    ap.add_argument("--journal", help="JSONL run journal path")
+    ap.add_argument(
+        "--journal", action="append",
+        help="JSONL run journal path (repeatable — the continual-learning "
+        "arc spans router, replica, and learn-daemon journals; events "
+        "merge sorted by timestamp)",
+    )
     ap.add_argument("--metrics", help="saved /metrics?format=json snapshot")
     ap.add_argument("--requests", help="saved /debug/requests snapshot")
     ap.add_argument("--quality", help="saved /debug/quality snapshot")
@@ -628,6 +831,13 @@ def main(argv=None) -> int:
         help="render the 'Fleet' section (router replica table + "
         "journal registration/rotation/deploy arc + fleet_* counters); "
         "--url then points at the router",
+    )
+    ap.add_argument(
+        "--learn", action="store_true",
+        help="render the 'Continual learning' section (trigger decisions "
+        "+ refit stage timings + shadow verdict + promotion/deploy arc + "
+        "the bracketing quality transitions, joined from the --journal "
+        "set; --bench joins the driving loadgen perturbation)",
     )
     ap.add_argument("--tail", type=int, default=10,
                     help="slowest sampled traces to show")
@@ -665,14 +875,22 @@ def main(argv=None) -> int:
         requests = _load_json(args.requests)
     if args.quality:
         quality = _load_json(args.quality)
-    manifest, events = (
-        _read_journal(args.journal) if args.journal else (None, [])
-    )
+    manifest, events = None, []
+    for jpath in args.journal or []:
+        m, ev = _read_journal(jpath)
+        manifest = manifest or m
+        events.extend(ev)
+    if len(args.journal or []) > 1:
+        events.sort(key=lambda e: e.get("ts") or "")
     bench = _load_json(args.bench) if args.bench else None
     score_bench = _load_json(args.score_bench) if args.score_bench else None
 
     rep = Report()
     _section_run(rep, manifest, health)
+    if args.learn:
+        # The continual-learning arc leads; the fleet/serving sections
+        # below (if requested) then detail the machinery it rode.
+        _section_learn(rep, events, bench)
     if args.fleet:
         # The fleet section replaces the replica-side serving sections:
         # a router has rotation state and routing counters, not an
@@ -690,6 +908,11 @@ def main(argv=None) -> int:
         # section replaces them, reusing --journal and --quality (pointed
         # at the run's quality.json).
         _section_score(rep, events, quality, score_bench)
+        if args.journal:
+            _section_journal(rep, events)
+    elif args.learn:
+        # Learn-only report (journals + bench, no live serving surface):
+        # the arc plus the raw journal, nothing replica-specific.
         if args.journal:
             _section_journal(rep, events)
     else:
