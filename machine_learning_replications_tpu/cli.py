@@ -819,12 +819,16 @@ def _write_score_metrics(args) -> None:
 
 def cmd_fleet(args) -> int:
     """Fleet tier (docs/FLEET.md): front-door router, rolling deploys,
-    and fleet status — the `cli fleet ROLE` entry points. All three are
-    jax-free: a router process needs no accelerator stack."""
+    the autoscaler daemon, and fleet status — the `cli fleet ROLE`
+    entry points. All are jax-free: a router or autoscaler process
+    needs no accelerator stack (the replicas it spawns pay that cost in
+    their own processes)."""
     if args.role == "router":
         return _run_fleet_router(args)
     if args.role == "deploy":
         return _run_fleet_deploy(args)
+    if args.role == "autoscale":
+        return _run_fleet_autoscale(args)
     return _run_fleet_status(args)
 
 
@@ -885,6 +889,134 @@ def _run_fleet_router(args) -> int:
         handle.serve_forever()
     finally:
         handle.shutdown()
+        if jrn is not None:
+            journal.set_journal(None)
+            jrn.close()
+            print(f"journal written to {jrn.path}", file=sys.stderr)
+    return 0
+
+
+def _run_fleet_autoscale(args) -> int:
+    """The elastic-fleet daemon (docs/FLEET.md "Elastic fleet"): watch
+    the router's load signals, spawn/retire local replica processes
+    through the drain-first lifecycle manager, replace crashed ones.
+    jax-free — the spawned replicas bring their own accelerator stack."""
+    import signal
+    import threading
+    import time
+
+    from machine_learning_replications_tpu.fleet.autoscale import (
+        AutoscaleDaemon,
+        AutoscalePolicy,
+        AutoscaleThresholds,
+    )
+    from machine_learning_replications_tpu.fleet.lifecycle import (
+        LifecycleManager,
+        ReplicaSpec,
+        RouterClient,
+    )
+    from machine_learning_replications_tpu.obs import journal
+    from machine_learning_replications_tpu.resilience import faults
+
+    for spec_text in args.inject or []:
+        try:
+            armed = faults.arm(spec_text)
+        except ValueError as exc:
+            raise SystemExit(f"--inject: {exc}")
+        print(f"fault armed: {armed.describe()}", file=sys.stderr)
+    jrn = None
+    if args.journal:
+        # Not _observed: the autoscaler must stay jax-free (the router's
+        # reasoning — no jax.monitoring hooks in this process).
+        jrn = journal.RunJournal(args.journal, command="fleet autoscale")
+        journal.set_journal(jrn)
+    say = lambda m: print(f"autoscale: {m}", file=sys.stderr)  # noqa: E731
+    spec = ReplicaSpec(
+        model=args.model,
+        register_url=args.router,
+        host=args.replica_host,
+        serve_args=tuple(args.serve_arg or []),
+        journal_dir=args.replica_journal_dir,
+    )
+    try:
+        manager = LifecycleManager(
+            spec,
+            RouterClient(args.router),
+            min_replicas=args.min,
+            max_replicas=args.max,
+            ready_deadline_s=args.ready_deadline,
+            drain_settle_s=args.drain_settle,
+            term_deadline_s=args.term_deadline,
+            respawn_backoff_s=args.respawn_backoff,
+            respawn_backoff_max_s=args.respawn_backoff_max,
+            say=say,
+        )
+        policy = AutoscalePolicy(
+            thresholds=AutoscaleThresholds(
+                out_queue_depth=args.out_queue_depth,
+                out_latency_ms=args.out_latency_ms,
+                out_shed_rate=args.out_shed_rate,
+                out_burn_rate=args.out_burn_rate,
+                in_queue_depth=args.in_queue_depth,
+                in_latency_ms=args.in_latency_ms,
+                in_shed_rate=args.in_shed_rate,
+                in_burn_rate=args.in_burn_rate,
+            ),
+            min_replicas=args.min,
+            max_replicas=args.max,
+            breach_polls=args.breach_polls,
+            idle_polls=args.idle_polls,
+            cooldown_s=args.cooldown,
+            step=args.step,
+        )
+    except ValueError as exc:
+        # Bad bounds/thresholds are operator input, not a crash.
+        raise SystemExit(f"fleet autoscale: {exc}")
+    daemon = AutoscaleDaemon(
+        args.router, manager, policy,
+        poll_interval_s=args.poll_interval, say=say,
+    )
+    manager.scale_to(args.min)
+    print(
+        f"autoscaling {args.min}..{args.max} replicas of {args.model} "
+        f"behind {args.router} (poll every {args.poll_interval:g}s)",
+        file=sys.stderr,
+    )
+    stop = {"now": False}
+
+    def _stop(signum, frame):
+        stop["now"] = True
+        print("autoscale: stopping after the current tick ...",
+              file=sys.stderr)
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    try:
+        daemon.run(stop_check=lambda: stop["now"],
+                   max_ticks=args.max_ticks)
+    finally:
+        if args.leave_running:
+            print(
+                "autoscale: leaving managed replicas running "
+                "(--leave-running)", file=sys.stderr,
+            )
+        else:
+            # Default teardown takes the managed fleet down with the
+            # daemon: orphaned children would keep serving unmanaged —
+            # alive but outside every control loop this command exists
+            # to provide.
+            closer = threading.Thread(target=manager.close, daemon=True)
+            closer.start()
+            closer.join(timeout=args.term_deadline + args.drain_settle + 5)
+        if args.metrics_out:
+            from machine_learning_replications_tpu.obs.registry import (
+                REGISTRY,
+            )
+
+            with open(args.metrics_out, "w") as f:
+                f.write(REGISTRY.render_prometheus())
+            print(f"metrics written to {args.metrics_out}",
+                  file=sys.stderr)
         if jrn is not None:
             journal.set_journal(None)
             jrn.close()
@@ -1600,6 +1732,140 @@ def build_parser() -> argparse.ArgumentParser:
         help="end-to-end rollout timeout (seconds)",
     )
     fd.set_defaults(fn=cmd_fleet)
+    fa = fsub.add_parser(
+        "autoscale",
+        help="elastic-fleet daemon: watch the router's load signals and "
+        "grow/shrink local replica processes with drain-first "
+        "retirement and crash replacement (docs/FLEET.md)",
+    )
+    fa.add_argument("--router", required=True, help="router base URL")
+    fa.add_argument(
+        "--model", required=True,
+        help="checkpoint directory every spawned replica serves",
+    )
+    fa.add_argument(
+        "--min", type=int, default=1,
+        help="minimum replica count (the daemon spawns up to this at "
+        "start and never retires below it)",
+    )
+    fa.add_argument(
+        "--max", type=int, default=4,
+        help="maximum replica count (scale-out stops here no matter the "
+        "load)",
+    )
+    fa.add_argument(
+        "--step", type=int, default=1,
+        help="replicas added/removed per scale decision",
+    )
+    fa.add_argument(
+        "--poll-interval", type=float, default=1.0,
+        help="seconds between signal polls",
+    )
+    fa.add_argument(
+        "--breach-polls", type=int, default=3,
+        help="consecutive breaching polls before a scale-out fires "
+        "(debounce)",
+    )
+    fa.add_argument(
+        "--idle-polls", type=int, default=10,
+        help="consecutive all-quiet polls before a scale-in fires",
+    )
+    fa.add_argument(
+        "--cooldown", type=float, default=30.0,
+        help="seconds after any scale action before the next may fire "
+        "(both directions — flapping load cannot thrash the fleet)",
+    )
+    fa.add_argument(
+        "--out-queue-depth", type=float, default=8.0,
+        help="scale-out when any replica's /healthz queue depth reaches "
+        "this (sustained --breach-polls)",
+    )
+    fa.add_argument(
+        "--out-latency-ms", type=float, default=250.0,
+        help="scale-out when the router's recent mean /predict latency "
+        "reaches this",
+    )
+    fa.add_argument(
+        "--out-shed-rate", type=float, default=0.02,
+        help="scale-out when the router's recent shed fraction reaches "
+        "this",
+    )
+    fa.add_argument(
+        "--out-burn-rate", type=float, default=4.0,
+        help="scale-out when any replica's worst SLO burn rate reaches "
+        "this",
+    )
+    fa.add_argument(
+        "--in-queue-depth", type=float, default=1.0,
+        help="scale-in requires every replica queue depth at or under "
+        "this (and every other signal under its twin) for --idle-polls",
+    )
+    fa.add_argument("--in-latency-ms", type=float, default=50.0)
+    fa.add_argument("--in-shed-rate", type=float, default=0.0)
+    fa.add_argument("--in-burn-rate", type=float, default=1.0)
+    fa.add_argument(
+        "--ready-deadline", type=float, default=300.0,
+        help="seconds a spawned replica may take to answer /readyz "
+        "before the spawn fails closed (killed, journaled, retried "
+        "under backoff)",
+    )
+    fa.add_argument(
+        "--drain-settle", type=float, default=10.0,
+        help="retirement drain bound: seconds to wait (after leaving "
+        "rotation) for the replica's queue to empty before SIGTERM",
+    )
+    fa.add_argument(
+        "--term-deadline", type=float, default=30.0,
+        help="seconds after SIGTERM before a replica that refuses to "
+        "drain is SIGKILLed",
+    )
+    fa.add_argument(
+        "--respawn-backoff", type=float, default=1.0,
+        help="initial crash-respawn backoff (doubles per consecutive "
+        "failure)",
+    )
+    fa.add_argument("--respawn-backoff-max", type=float, default=30.0)
+    fa.add_argument(
+        "--replica-host", default="127.0.0.1",
+        help="host spawned replicas bind (ports are allocated fresh)",
+    )
+    fa.add_argument(
+        "--serve-arg", action="append", metavar="ARG", default=None,
+        help="extra `serve` flag for every spawned replica (repeatable, "
+        "one token per use; use the = form for tokens that start with a "
+        "dash: --serve-arg=--buckets --serve-arg=1,8)",
+    )
+    fa.add_argument(
+        "--replica-journal-dir", default=None,
+        help="directory for per-replica journals "
+        "(replica_<id>.jsonl each)",
+    )
+    fa.add_argument(
+        "--max-ticks", type=int, default=None,
+        help="exit after N polls (drills/CI; default: run until "
+        "signalled)",
+    )
+    fa.add_argument(
+        "--leave-running", action="store_true",
+        help="on shutdown, leave managed replicas serving (default: "
+        "drain and stop them with the daemon)",
+    )
+    fa.add_argument(
+        "--inject", action="append", metavar="SPEC", default=None,
+        help="arm a lifecycle faultpoint in this process (repeatable): "
+        "lifecycle.spawn:corrupt@once, lifecycle.drain:corrupt@once, … "
+        "(docs/RESILIENCE.md faultpoint catalog)",
+    )
+    fa.add_argument(
+        "--metrics-out", default=None,
+        help="write the daemon's final Prometheus exposition "
+        "(autoscale_*, lifecycle_* families) to this path on exit",
+    )
+    fa.add_argument(
+        "--journal", default=None,
+        help="JSONL journal path (autoscale decisions + lifecycle arcs)",
+    )
+    fa.set_defaults(fn=cmd_fleet)
     fs = fsub.add_parser(
         "status", help="print the router's registry and health snapshot"
     )

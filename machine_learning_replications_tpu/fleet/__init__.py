@@ -13,17 +13,33 @@ fleet tier is the layer that composes them into a *service*:
     the serve transport reused, with per-request retry/hedging, deadline
     propagation, and replica/version header passthrough.
   * ``fleet.deploy`` — rolling deploys of versioned checkpoints
-    (``persist.checkpoint_version``), one replica at a time through the
-    replica-side ``/admin/deploy`` warm swap, with the last-known-good
-    rollback as the safety net.
+    (``persist.checkpoint_version``), in capacity-gated waves through
+    the replica-side ``/admin/deploy`` warm swap, with the
+    last-known-good rollback as the safety net.
+  * ``fleet.lifecycle`` — the replica lifecycle manager: spawn →
+    ready → drain-first retire (hold → settle → SIGTERM → deadline
+    SIGKILL) → crash replacement with backoff, every arc journaled.
+  * ``fleet.autoscale`` — the load-driven control loop over it:
+    router/replica load signals → debounced, cooled-down, bounded
+    scale decisions (``cli fleet autoscale``).
 
 Deliberately jax-free: a router process starts in milliseconds and
 needs no accelerator stack.
 """
 
+from machine_learning_replications_tpu.fleet.autoscale import (
+    AutoscaleDaemon,
+    AutoscalePolicy,
+    AutoscaleThresholds,
+)
 from machine_learning_replications_tpu.fleet.deploy import (
     manifest_version,
     rolling_deploy,
+)
+from machine_learning_replications_tpu.fleet.lifecycle import (
+    LifecycleManager,
+    ReplicaSpec,
+    RouterClient,
 )
 from machine_learning_replications_tpu.fleet.health import (
     HealthProber,
@@ -39,9 +55,15 @@ from machine_learning_replications_tpu.fleet.router import (
 )
 
 __all__ = [
+    "AutoscaleDaemon",
+    "AutoscalePolicy",
+    "AutoscaleThresholds",
     "HealthProber",
+    "LifecycleManager",
     "Replica",
     "ReplicaRegistry",
+    "ReplicaSpec",
+    "RouterClient",
     "RouterHandle",
     "make_router",
     "manifest_version",

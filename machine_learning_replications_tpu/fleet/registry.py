@@ -269,11 +269,14 @@ class ReplicaRegistry:
             rep.held = True
             self._refresh_gauge_locked()
         if was_in:
+            # Only an in-rotation replica LEAVES rotation here: holding
+            # a probing/out replica (a lifecycle retire racing a crash)
+            # must not journal a rotation that never happened.
             FLEET_ROTATIONS.inc(direction="out")
-        journal.event(
-            "fleet_rotation", replica=replica_id, direction="out",
-            reason="admin_hold",
-        )
+            journal.event(
+                "fleet_rotation", replica=replica_id, direction="out",
+                reason="admin_hold",
+            )
         return True
 
     def release(self, replica_id: str) -> bool:
@@ -285,11 +288,15 @@ class ReplicaRegistry:
             now_in = rep.state == READY
             self._refresh_gauge_locked()
         if now_in:
+            # A replica that went OUT while held (stopped heartbeating
+            # mid-drain) does NOT re-enter rotation on release — probes
+            # own that door; journaling direction=in here would claim a
+            # rotation the router never made.
             FLEET_ROTATIONS.inc(direction="in")
-        journal.event(
-            "fleet_rotation", replica=replica_id, direction="in",
-            reason="admin_release",
-        )
+            journal.event(
+                "fleet_rotation", replica=replica_id, direction="in",
+                reason="admin_release",
+            )
         return True
 
     # -- probe feedback ------------------------------------------------------

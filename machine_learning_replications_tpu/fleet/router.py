@@ -655,9 +655,12 @@ class _RouterApp:
     def _post_replicas(self, req, rsp) -> None:
         """Registration endpoint (``cli serve --register`` posts here):
         ``{"id", "url"}`` adds a replica, ``{"deregister": id}`` removes
-        one. Probing begins on the next prober tick; rotation in follows
-        the first ready probe — a registered-but-cold replica never
-        receives traffic."""
+        one, ``{"hold": id}`` / ``{"release": id}`` toggle the admin
+        hold — the out-of-process face of ``registry.hold`` the
+        lifecycle manager's drain-first retirement needs (an in-process
+        deploy controller calls the registry directly). Probing begins
+        on the next prober tick; rotation in follows the first ready
+        probe — a registered-but-cold replica never receives traffic."""
         try:
             body = json.loads(req.body or b"{}")
             if not isinstance(body, dict):
@@ -666,10 +669,24 @@ class _RouterApp:
                 found = self.registry.deregister(str(body["deregister"]))
                 rsp.send_json(200, {"deregistered": found})
                 return
+            if "hold" in body:
+                rsp.send_json(200, {
+                    "held": self.registry.hold(str(body["hold"])),
+                })
+                return
+            if "release" in body:
+                rsp.send_json(200, {
+                    "released": self.registry.release(
+                        str(body["release"])
+                    ),
+                })
+                return
             rid, url = body.get("id"), body.get("url")
             if not rid or not url:
-                raise ValueError('expected {"id": ..., "url": ...} or '
-                                 '{"deregister": id}')
+                raise ValueError(
+                    'expected {"id": ..., "url": ...}, {"deregister": id}, '
+                    '{"hold": id}, or {"release": id}'
+                )
         except (ValueError, json.JSONDecodeError) as exc:
             rsp.send_json(400, {"error": str(exc)})
             return

@@ -32,6 +32,17 @@ nothing:
   persist.restore     ``persist/orbax_io.py`` restore entry           raise delay
                       (corrupt = flip bytes on disk so integrity     corrupt
                       verification must catch it)
+  lifecycle.spawn     ``fleet/lifecycle.py`` replica spawn entry     raise delay
+                      (raise = the spawn attempt itself fails;       corrupt
+                      corrupt = the manager launches a replica that
+                      can never become ready — the ready-deadline
+                      branch must catch it and fail closed)
+  lifecycle.drain     ``fleet/lifecycle.py`` drain-first retirement  raise delay
+                      entry (raise = the retirement is aborted and   corrupt
+                      retried; corrupt = the graceful SIGTERM is
+                      suppressed, simulating a replica that refuses
+                      to drain — the kill-deadline escalation must
+                      fire)
   ==================  =============================================  ==========
 
 **Modes.** ``raise`` throws ``InjectedFault`` from the faultpoint;
@@ -87,6 +98,8 @@ SITES: dict[str, tuple[str, ...]] = {
     "engine.warmup": ("raise", "delay"),
     "persist.save": ("raise", "delay", "corrupt"),
     "persist.restore": ("raise", "delay", "corrupt"),
+    "lifecycle.spawn": ("raise", "delay", "corrupt"),
+    "lifecycle.drain": ("raise", "delay", "corrupt"),
 }
 
 # Registered at import so the family (and its exposition metadata) exists

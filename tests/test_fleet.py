@@ -60,6 +60,10 @@ class _StubReplica:
         self.stall_s = 2.0
         self.served = 0
         self.deadline_headers: list[str | None] = []
+        # /admin/deploy behavior (the batched-rollout test): hold the
+        # "warm swap" for deploy_s, then serve deploy_to.
+        self.deploy_s = 0.0
+        self.deploy_to = 2
 
     def handle_request(self, req, rsp) -> None:
         if req.path == "/readyz":
@@ -68,6 +72,15 @@ class _StubReplica:
                 {"ready": self.ready, "reasons": [],
                  "replica": self.rid, "version": self.version},
             )
+            return
+        if req.path == "/admin/deploy":
+            if self.deploy_s:
+                time.sleep(self.deploy_s)
+            self.version = self.deploy_to
+            rsp.send_json(200, {"deploy": {
+                "version": self.version, "rolled_back": False,
+                "seconds": self.deploy_s,
+            }})
             return
         if req.path != "/predict":
             rsp.send_json(404, {"error": "nope"})
@@ -680,6 +693,196 @@ def test_obs_report_fleet_section(tmp_path):
     assert "## Fleet" in out.stdout
     assert "r1" in out.stdout and "ok=10" in out.stdout
     assert "deploy arc" in out.stdout and "version 2" in out.stdout
+
+
+def test_rolling_deploy_batched_holds_respect_capacity_gate():
+    """ISSUE 11 satellite: a 4-replica rollout with concurrency 3 —
+    warm swaps overlap (observed ≥ 2 concurrent holds) and the number
+    of in-rotation replicas never drops below the gate, sampled
+    continuously through the rollout."""
+    router, stubs, httpds, base = _stub_fleet(4, probe_interval_s=0.05)
+    try:
+        for s in stubs:
+            s.deploy_s = 0.4
+            s.deploy_to = 2
+        floor_violations: list = []
+        max_held = [0]
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                snap = router.registry.snapshot()
+                in_rot = sum(1 for r in snap if r["in_rotation"])
+                held = sum(1 for r in snap if r["held"])
+                max_held[0] = max(max_held[0], held)
+                if in_rot < 1:
+                    floor_violations.append(snap)
+                time.sleep(0.01)
+
+        sampler_thread = threading.Thread(target=sampler, daemon=True)
+        sampler_thread.start()
+        report = rolling_deploy(
+            router.registry, "/nonexistent-ckpt", concurrency=3,
+            admin_timeout_s=30.0, ready_timeout_s=30.0,
+        )
+        stop.set()
+        sampler_thread.join(timeout=5)
+        assert report["result"] == "ok", report
+        assert report["target_version"] == 2
+        assert report["concurrency"] == 3
+        assert [s["achieved_version"] for s in report["replicas"]] == \
+            [2, 2, 2, 2]
+        assert not floor_violations, floor_violations[0]
+        # The point of batching: the 0.4 s warm swaps really overlapped.
+        assert max_held[0] >= 2, max_held
+        snap = router.registry.snapshot()
+        assert all(r["version"] == 2 and r["in_rotation"] for r in snap)
+    finally:
+        _teardown(router, httpds)
+
+
+def test_rolling_deploy_serial_default_unchanged():
+    # concurrency=1 keeps the one-at-a-time contract byte-for-byte.
+    router, stubs, httpds, base = _stub_fleet(2, probe_interval_s=0.05)
+    try:
+        for s in stubs:
+            s.deploy_to = 2
+        report = rolling_deploy(
+            router.registry, "/nonexistent-ckpt",
+            admin_timeout_s=30.0, ready_timeout_s=30.0,
+        )
+        assert report["result"] == "ok"
+        assert [s["achieved_version"] for s in report["replicas"]] == [2, 2]
+    finally:
+        _teardown(router, httpds)
+
+
+def test_router_hold_release_http_ops():
+    """The lifecycle manager's drain-first door: {"hold": id} removes a
+    replica from routing over HTTP, {"release": id} puts it back."""
+    router, stubs, httpds, base = _stub_fleet(2)
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                base + "/fleet/replicas", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return json.loads(resp.read())
+
+        assert post({"hold": "r1"})["held"] is True
+        assert not router.registry.get("r1")["in_rotation"]
+        for _ in range(6):
+            code, headers, _ = _post_predict(base)
+            assert code == 200 and headers["X-Replica"] == "r2"
+        assert post({"hold": "r1"})["held"] is False  # already held
+        assert post({"release": "r1"})["released"] is True
+        assert router.registry.get("r1")["in_rotation"]
+        assert post({"release": "ghost"})["released"] is False
+    finally:
+        _teardown(router, httpds)
+
+
+# ---------------------------------------------------------------------------
+# registry heartbeat/expiry edges (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_probe_expiry_mid_drain_hold():
+    """A replica that stops answering mid-drain (held): the OUT
+    transition must not double-count the rotation it already left at
+    hold time, and release() must NOT put a dead replica back in
+    rotation — probes own that door."""
+    reg = ReplicaRegistry(fail_threshold=2, recover_probes=2)
+    reg.register("a", "http://x:1")
+    reg.observe_probe("a", ok=True, ready=True)
+    in0 = FLEET_ROTATIONS.labels(direction="in").value
+    out0 = FLEET_ROTATIONS.labels(direction="out").value
+    assert reg.hold("a")
+    assert FLEET_ROTATIONS.labels(direction="out").value == out0 + 1
+    # The drain outlives the process: probes start failing while held.
+    reg.observe_probe("a", ok=False, ready=False)
+    reg.observe_probe("a", ok=False, ready=False)
+    assert reg.get("a")["state"] == "out"
+    assert FLEET_ROTATIONS.labels(direction="out").value == out0 + 1
+    assert reg.release("a")
+    assert not reg.get("a")["in_rotation"]
+    assert FLEET_ROTATIONS.labels(direction="in").value == in0
+    # Recovery is earned through the normal hysteresis, nothing else.
+    reg.observe_probe("a", ok=True, ready=True)
+    assert not reg.get("a")["in_rotation"]
+    reg.observe_probe("a", ok=True, ready=True)
+    assert reg.get("a")["in_rotation"]
+    assert FLEET_ROTATIONS.labels(direction="in").value == in0 + 1
+
+
+def test_registry_hold_of_never_ready_replica_counts_no_rotation():
+    reg = ReplicaRegistry()
+    reg.register("a", "http://x:1")  # probing: never entered rotation
+    out0 = FLEET_ROTATIONS.labels(direction="out").value
+    assert reg.hold("a")
+    assert FLEET_ROTATIONS.labels(direction="out").value == out0
+
+
+def test_registry_reenrol_same_id_after_crash_keeps_hysteresis():
+    """A crashed replica's replacement re-enrols under the same id and
+    url (the lifecycle manager's respawn): the idempotent registration
+    must keep the OUT state — re-entering rotation is earned through
+    recover_probes, never granted by a registration POST."""
+    reg = ReplicaRegistry(fail_threshold=2, recover_probes=2)
+    reg.register("a", "http://x:1")
+    reg.observe_probe("a", ok=True, ready=True)
+    reg.observe_probe("a", ok=False, ready=False)
+    reg.observe_probe("a", ok=False, ready=False)
+    assert reg.get("a")["state"] == "out"
+    # The respawned process's registration heartbeat.
+    reg.register("a", "http://x:1")
+    assert reg.get("a")["state"] == "out"
+    assert reg.pick() is None
+    reg.observe_probe("a", ok=True, ready=True)
+    assert not reg.get("a")["in_rotation"]  # 1 of 2
+    reg.observe_probe("a", ok=True, ready=True)
+    assert reg.get("a")["in_rotation"]
+
+
+def test_registry_expiry_races_concurrent_scale_in():
+    """Probe expiry racing a concurrent deregistration (the autoscaler's
+    scale-in) and hold/release churn: no exceptions, no resurrection of
+    the deregistered replica, registry left consistent."""
+    reg = ReplicaRegistry(fail_threshold=1)
+    for rid in ("a", "b"):
+        reg.register(rid, f"http://{rid}:1")
+        reg.observe_probe(rid, ok=True, ready=True)
+    stop = threading.Event()
+    errors: list = []
+
+    def prober():
+        while not stop.is_set():
+            try:
+                reg.observe_probe("a", ok=False, ready=False)
+                reg.observe_probe("a", ok=True, ready=True)
+                reg.hold("a")
+                reg.release("a")
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=prober) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    assert reg.deregister("a")
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+    assert reg.get("a") is None
+    assert not reg.deregister("a")
+    assert not reg.hold("a") and not reg.release("a")
+    reg.observe_probe("a", ok=True, ready=True)  # late expiry: no-op
+    assert reg.get("a") is None
+    assert [r["id"] for r in reg.snapshot()] == ["b"]
+    assert reg.pick()["id"] == "b"
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,37 @@ scenarios asserted under it —
                     stops as ``rolled_back``, and the fleet keeps
                     serving the old version — still zero wrong answers.
 
+``--surge`` runs the ELASTIC-FLEET drill (docs/FLEET.md "Elastic
+fleet"): an in-process router + autoscaler daemon + lifecycle manager
+over real ``cli serve`` replica subprocesses, driven end-to-end by ONE
+``tools/loadgen.py --ramp`` client whose paced rate steps low → burst →
+low. The asserted arc, all journaled:
+
+  surge             the burst breaches the autoscaler's queue/latency
+                    thresholds for the debounce window → a journaled
+                    ``autoscale_decision`` scale-out → a new replica is
+                    spawned, warms, and probes into rotation.
+  kill mid-burst    one replica is SIGKILLed under load: the router's
+                    retry/breaker machinery absorbs it client-side, the
+                    manager detects the dead process, deregisters it,
+                    and respawns it on the same id/port (journaled
+                    ``lifecycle_crash`` → ``lifecycle_spawn``
+                    respawn=true → ``lifecycle_ready``).
+  quiet             the burst ends → a debounced, cooled-down scale-in
+                    retires the surge replica DRAIN-FIRST: rotation
+                    hold → queue settle → SIGTERM → clean exit, with no
+                    SIGKILL in the arc.
+  fail closed       an armed ``lifecycle.spawn:corrupt`` fault makes the
+                    next spawn unready-forever: the ready deadline kills
+                    it, journals ``lifecycle_spawn_failed``, and the
+                    fleet is merely not grown — zero client impact.
+
+The invariant: the loadgen artifact shows ZERO failed client requests
+(n_err == 0, zero retry give-ups) across the whole surge → kill →
+recover arc, and the router page (which carries the ``autoscale_*`` /
+``lifecycle_*`` families — everything control-plane runs in one
+process) passes the strict validator.
+
 The router's ``/metrics`` page is strict-validated and written to
 ``--metrics-out`` for CI to re-validate as an artifact.
 
@@ -602,6 +633,342 @@ def run_fleet_drill(args) -> int:
     return 0
 
 
+def run_surge_drill(args) -> int:
+    """The elastic-fleet drill (see module docstring): autoscaler +
+    lifecycle manager over real replica subprocesses, one ramped loadgen
+    client, surge → scale-out → SIGKILL → replacement → scale-in."""
+    import threading
+
+    t_start = time.monotonic()
+    from machine_learning_replications_tpu.fleet import (
+        AutoscaleDaemon,
+        AutoscalePolicy,
+        AutoscaleThresholds,
+        LifecycleManager,
+        ReplicaSpec,
+        RouterClient,
+        make_router,
+    )
+    from machine_learning_replications_tpu.fleet.lifecycle import (
+        LIFECYCLE_TRANSITIONS,
+        kill_replica,
+    )
+    from machine_learning_replications_tpu.obs import journal
+    from machine_learning_replications_tpu.persist import orbax_io
+    from machine_learning_replications_tpu.resilience import faults
+
+    workdir = tempfile.mkdtemp(prefix="chaos_surge_")
+    journal_path = args.journal or os.path.join(workdir, "surge.jsonl")
+    jrn = journal.RunJournal(journal_path, command="chaos_drill --surge")
+    journal.set_journal(jrn)
+    say = lambda m: print(f"surge: {m}", file=sys.stderr)  # noqa: E731
+
+    ckpt = os.path.join(workdir, "model")
+    orbax_io.save_model(ckpt, make_sklearn_params(seed=7))
+
+    # hedge_ms sits well above the burst's saturation-plateau latency:
+    # a hedge that fires on EVERY request at the plateau would double
+    # the offered load on an already saturated fleet (positive
+    # feedback) — hedging is for stragglers; the retry path (not
+    # hedging) absorbs the SIGKILL.
+    router = make_router(
+        port=0, probe_interval_s=0.2, request_timeout_s=10.0,
+        hedge_ms=2000.0, max_attempts=4,
+    ).start_background()
+    base = f"http://{router.address[0]}:{router.address[1]}"
+    # The deliberately EXPENSIVE replica configuration. The sandbox
+    # model is too cheap to surge: single-row traffic rides the host
+    # fast path and batch amortization lets one replica absorb ~1000
+    # qps — more than a 2-core box's client can offer, so no reachable
+    # burst ever breaches a threshold. Replicas therefore run device-
+    # path-only, unbatched, with an armed ``engine.compute:delay``
+    # emulating a production-cost model (~10 ms/row → ~95 qps/replica,
+    # sleep not CPU, so the client stays honest). The paced closed loop
+    # then saturates the fleet for real — in-flight bounded by
+    # --connections, so burst latency plateaus at connections/capacity
+    # (Little's law) instead of running away into client timeouts.
+    spec = ReplicaSpec(
+        model=ckpt, register_url=base,
+        serve_args=("--buckets", "1", "--max-wait-ms", "0",
+                    "--no-host-path", "--xla-intra-op-threads", "1",
+                    "--inject",
+                    f"engine.compute:delay={args.compute_delay_ms / 1e3:g}"),
+        journal_dir=workdir,
+    )
+    manager = LifecycleManager(
+        spec, RouterClient(base),
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        ready_deadline_s=args.ready_deadline, drain_settle_s=8.0,
+        term_deadline_s=30.0, respawn_backoff_s=0.5, say=say,
+    )
+    policy = AutoscalePolicy(
+        thresholds=AutoscaleThresholds(
+            out_queue_depth=args.out_queue_depth,
+            out_latency_ms=args.out_latency_ms,
+            out_shed_rate=0.02, out_burn_rate=None,
+            in_queue_depth=1.0, in_latency_ms=args.in_latency_ms,
+            in_shed_rate=0.0, in_burn_rate=None,
+        ),
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        breach_polls=args.breach_polls, idle_polls=args.idle_polls,
+        cooldown_s=args.cooldown,
+    )
+    daemon = AutoscaleDaemon(base, manager, policy, poll_interval_s=1.0,
+                             say=say)
+    manager.scale_to(args.min_replicas)
+    stop = threading.Event()
+    daemon_thread = threading.Thread(
+        target=lambda: daemon.run(stop_check=stop.is_set),
+        name="surge-autoscaler", daemon=True,
+    )
+    daemon_thread.start()
+
+    lg = None
+    lg_path = os.path.join(workdir, "loadgen.json")
+    timeline: dict = {}
+    try:
+        wait_until(
+            lambda: router.registry.ready_count() >= args.min_replicas,
+            600.0, f"{args.min_replicas} replicas warm and in rotation",
+            poll_s=0.5,
+        )
+        say(f"baseline fleet of {args.min_replicas} ready in "
+            f"{time.monotonic() - t_start:.0f}s")
+        ramp = (
+            f"0:{args.ramp_low:g},{args.burst_start:g}:{args.ramp_high:g},"
+            f"{args.burst_end:g}:{args.ramp_low:g}"
+        )
+        lg = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "loadgen.py"),
+             "--url", base, "--connections", str(args.connections),
+             "--ramp", ramp, "--duration", str(args.client_duration),
+             "--retries", "8", "--retry-base-ms", "50",
+             "--timeout", "20", "--out", lg_path],
+            stdout=subprocess.DEVNULL,
+        )
+        t_client0 = time.monotonic()
+
+        # --- surge → journaled scale-out ----------------------------------
+        wait_until(
+            lambda: router.registry.ready_count() > args.min_replicas,
+            args.burst_end + args.ready_deadline,
+            "burst-driven scale-out (new replica warm and in rotation)",
+            poll_s=0.5,
+        )
+        timeline["scale_out_ready_s"] = round(
+            time.monotonic() - t_client0, 1
+        )
+        say(f"scale-out landed at {timeline['scale_out_ready_s']}s "
+            "into the client run")
+
+        # --- SIGKILL one replica mid-burst → journaled replacement --------
+        victim = manager.get("as-1")
+        assert victim is not None and victim.proc is not None
+        old_pid = victim.proc.pid
+        kill_replica(victim)
+        timeline["kill_s"] = round(time.monotonic() - t_client0, 1)
+        say(f"SIGKILLed replica as-1 (pid {old_pid})")
+        wait_until(
+            lambda: (
+                (rep := manager.get("as-1")) is not None
+                and rep.proc is not None and rep.proc.pid != old_pid
+                and rep.state == "ready"
+            ),
+            args.ready_deadline + 60.0,
+            "killed replica respawned and ready again", poll_s=0.5,
+        )
+        timeline["replaced_ready_s"] = round(
+            time.monotonic() - t_client0, 1
+        )
+        say(f"replacement ready at {timeline['replaced_ready_s']}s")
+        wait_until(
+            lambda: router.registry.ready_count() > args.min_replicas,
+            120.0, "replacement back in rotation", poll_s=0.5,
+        )
+
+        # --- burst ends → drain-first scale-in ----------------------------
+        wait_until(
+            lambda: router.registry.ready_count() == args.min_replicas
+            and manager.counts()["active"] == args.min_replicas
+            and manager.counts()["draining"] == 0
+            and manager.counts()["terminating"] == 0,
+            args.burst_end + args.client_duration,
+            "drain-first scale-in back to the baseline fleet",
+            poll_s=0.5,
+        )
+        timeline["scale_in_done_s"] = round(
+            time.monotonic() - t_client0, 1
+        )
+        say(f"scale-in done at {timeline['scale_in_done_s']}s")
+
+        assert lg.wait(timeout=args.client_duration + 120) == 0, \
+            "loadgen client failed"
+        with open(lg_path) as f:
+            lg_art = json.load(f)
+
+        # --- fault branch: unready spawn fails closed ---------------------
+        # The daemon is stopped first so a racing scale-in decision
+        # cannot retire the deliberately-corrupt slot before it spawns.
+        stop.set()
+        daemon_thread.join(timeout=30)
+        failed0 = LIFECYCLE_TRANSITIONS.labels(event="spawn_failed").value
+        ready_before = router.registry.ready_count()
+        faults.arm("lifecycle.spawn:corrupt@once")
+        manager.scale_to(args.min_replicas + 1)
+        manager.ready_deadline_s = args.spawn_fault_deadline
+        deadline = time.monotonic() + args.spawn_fault_deadline + 120
+        while LIFECYCLE_TRANSITIONS.labels(
+            event="spawn_failed"
+        ).value == failed0:
+            assert time.monotonic() < deadline, \
+                "corrupt spawn never failed closed"
+            manager.tick()
+            time.sleep(0.5)
+        assert router.registry.ready_count() == ready_before, (
+            "an unready spawn changed rotation capacity",
+            router.registry.snapshot(),
+        )
+        say("corrupt spawn failed closed (journaled, fleet unchanged)")
+        faults.reset()
+        manager.scale_to(args.min_replicas)
+        manager.tick()  # drops the pending retry slot
+        timeline["spawn_fault_s"] = round(
+            time.monotonic() - t_client0, 1
+        )
+
+        # --- evidence ------------------------------------------------------
+        with urllib.request.urlopen(
+            base + "/metrics", timeout=HARD_TIMEOUT_S
+        ) as resp:
+            page = resp.read().decode()
+        for family in ("autoscale_decisions_total", "autoscale_signal",
+                       "autoscale_streak", "autoscale_desired_replicas",
+                       "lifecycle_transitions_total", "lifecycle_replicas",
+                       "fleet_requests_total", "fleet_rotations_total"):
+            assert family in page, f"{family} missing from /metrics"
+        from validate_metrics import validate  # noqa: E402
+
+        errs = validate(page)
+        assert not errs, f"/metrics failed validation: {errs[:5]}"
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                f.write(page)
+            print(f"metrics written to {args.metrics_out}",
+                  file=sys.stderr)
+    finally:
+        stop.set()
+        if lg is not None and lg.poll() is None:
+            lg.kill()
+        daemon_thread.join(timeout=10)
+        manager.close()
+        router.shutdown()
+        journal.set_journal(None)
+        jrn.close()
+
+    # -- journal assertions: the whole arc, in order ------------------------
+    with open(journal_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    kinds = {e.get("kind") for e in events}
+    for needed in ("autoscale_decision", "lifecycle_spawn",
+                   "lifecycle_ready", "lifecycle_crash",
+                   "lifecycle_drain", "lifecycle_term", "lifecycle_exit",
+                   "lifecycle_spawn_failed", "fault_injected",
+                   "fleet_rotation"):
+        assert needed in kinds, f"journal lacks {needed!r}"
+    fired = [
+        e for e in events
+        if e.get("kind") == "autoscale_decision" and e.get("decision")
+    ]
+    assert any(e["decision"] == "scale_out" for e in fired), fired
+    assert any(e["decision"] == "scale_in" for e in fired), fired
+    respawns = [
+        e for e in events
+        if e.get("kind") == "lifecycle_spawn" and e.get("respawn")
+    ]
+    assert respawns, "no journaled crash respawn"
+    # Drain-first: the scale-in retirement's drain precedes its term
+    # precedes its exit, and that replica was never SIGKILLed.
+    drains = [
+        e for e in events
+        if e.get("kind") == "lifecycle_drain"
+        and e.get("reason") == "scale_in"
+    ]
+    assert drains, "no journaled drain-first scale-in"
+    retired = drains[-1]["replica"]
+    arc = [
+        e["kind"] for e in events
+        if e.get("replica") == retired
+        and e.get("kind") in ("lifecycle_drain", "lifecycle_term",
+                              "lifecycle_kill", "lifecycle_exit")
+    ]
+    tail = arc[arc.index("lifecycle_drain"):]
+    assert tail == ["lifecycle_drain", "lifecycle_term",
+                    "lifecycle_exit"], (retired, arc)
+
+    zero_failures = (
+        lg_art["n_err"] == 0
+        and (lg_art.get("retry") or {}).get("give_ups", 0) == 0
+    )
+    artifact = {
+        "kind": "fleet_scale_drill",
+        "manifest": journal.run_manifest(command="chaos_drill --surge"),
+        "invariant": {
+            "statement": "under a surge → SIGKILL → recover arc driven "
+            "by one ramped client: journaled scale-out, automatic "
+            "crash replacement, drain-first scale-in (no SIGKILL in "
+            "the retirement arc), an injected unready spawn failing "
+            "closed — and zero failed client requests end to end",
+            "client_errors": lg_art["n_err"],
+            "retry_give_ups": (lg_art.get("retry") or {}).get("give_ups"),
+            "holds": zero_failures,
+        },
+        "fleet": {
+            "min_replicas": args.min_replicas,
+            "max_replicas": args.max_replicas,
+            "retired_drain_first": retired,
+            "respawned": sorted({e["replica"] for e in respawns}),
+        },
+        "timeline_s": timeline,
+        "client": {
+            "ramp": lg_art.get("ramp"),
+            "n_ok": lg_art["n_ok"],
+            "n_shed": lg_art["n_shed"],
+            "n_err": lg_art["n_err"],
+            "achieved_qps": lg_art["achieved_qps"],
+            "latency_ms": lg_art["latency_ms"],
+            "retry": lg_art.get("retry"),
+        },
+        "autoscale_decisions": [
+            {
+                "ts": e.get("ts"), "decision": e.get("decision"),
+                "target": e.get("target"), "reason": e.get("reason"),
+                "signals": e.get("signals"),
+            }
+            for e in fired
+        ],
+        "journal_event_kinds": sorted(k for k in kinds if k),
+        "duration_s": round(time.monotonic() - t_start, 3),
+    }
+    line = json.dumps(artifact, indent=1)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"artifact written to {args.out}", file=sys.stderr)
+    assert zero_failures, "SURGE DRILL INVARIANT VIOLATED"
+    print(
+        "surge invariant holds: zero failed client requests over "
+        f"{lg_art['n_ok']} ok replies; scale-out at "
+        f"{timeline['scale_out_ready_s']}s, replacement at "
+        f"{timeline['replaced_ready_s']}s, scale-in at "
+        f"{timeline['scale_in_done_s']}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--out", default=None, help="artifact path (JSON)")
@@ -618,10 +985,63 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--metrics-out", default=None,
-        help="(--fleet) write the router's final /metrics page here "
-        "after strict validation",
+        help="(--fleet/--surge) write the router's final /metrics page "
+        "here after strict validation",
     )
+    ap.add_argument(
+        "--surge", action="store_true",
+        help="run the ELASTIC-FLEET drill instead: autoscaler + "
+        "lifecycle manager over real replica subprocesses under one "
+        "ramped loadgen client — journaled scale-out under burst, "
+        "SIGKILL mid-burst replaced automatically, drain-first "
+        "scale-in, an injected unready spawn failing closed, zero "
+        "failed client requests (docs/FLEET.md 'Elastic fleet')",
+    )
+    ap.add_argument("--connections", type=int, default=128,
+                    help="(--surge) loadgen keep-alive connections; the "
+                    "closed loop bounds in-flight work at this, so the "
+                    "burst's latency plateaus at connections/capacity "
+                    "(Little's law) instead of running away into "
+                    "client timeouts")
+    ap.add_argument("--ramp-low", type=float, default=0.25,
+                    help="(--surge) per-connection rps outside the burst")
+    ap.add_argument("--compute-delay-ms", type=float, default=8.0,
+                    help="(--surge) per-compute delay armed in every "
+                    "replica (engine.compute:delay) emulating a "
+                    "production-cost model — sets the fleet capacity "
+                    "the burst must exceed (~1000/(2.5+this) qps per "
+                    "replica)")
+    ap.add_argument("--ramp-high", type=float, default=6.0,
+                    help="(--surge) per-connection rps during the burst "
+                    "(offered = connections x this; keep it above the "
+                    "fleet's capacity — the pacing degrades to closed-"
+                    "loop saturation when the fleet can't keep up)")
+    ap.add_argument("--burst-start", type=float, default=15.0,
+                    help="(--surge) seconds into the client run the "
+                    "burst begins")
+    ap.add_argument("--burst-end", type=float, default=210.0,
+                    help="(--surge) seconds into the client run the "
+                    "burst ends")
+    ap.add_argument("--client-duration", type=float, default=330.0,
+                    help="(--surge) total loadgen duration")
+    ap.add_argument("--min-replicas", type=int, default=2)
+    ap.add_argument("--max-replicas", type=int, default=3)
+    ap.add_argument("--out-queue-depth", type=float, default=3.0,
+                    help="(--surge) scale-out queue-depth threshold")
+    ap.add_argument("--out-latency-ms", type=float, default=150.0)
+    ap.add_argument("--in-latency-ms", type=float, default=40.0)
+    ap.add_argument("--breach-polls", type=int, default=3)
+    ap.add_argument("--idle-polls", type=int, default=8)
+    ap.add_argument("--cooldown", type=float, default=20.0)
+    ap.add_argument("--ready-deadline", type=float, default=360.0,
+                    help="(--surge) spawn-to-ready bound for real "
+                    "replica warmups")
+    ap.add_argument("--spawn-fault-deadline", type=float, default=30.0,
+                    help="(--surge) tightened ready deadline for the "
+                    "fail-closed corrupt-spawn branch")
     args = ap.parse_args(argv)
+    if args.surge:
+        return run_surge_drill(args)
     if args.fleet:
         return run_fleet_drill(args)
 

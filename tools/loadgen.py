@@ -236,6 +236,83 @@ class _Bodies:
         }
 
 
+class _RateSchedule:
+    """A per-connection request-rate schedule for the paced event-loop
+    client — the one-client surge→quiet arc (``--ramp``). Parsed from
+    ``T:RATE`` points (seconds into the run : requests/s per
+    connection), e.g. ``0:1,15:6,75:1`` = 1 rps/conn for 15 s, burst at
+    6 rps/conn until 75 s, quiet tail after. ``shape``:
+
+      step      the rate jumps at each point and holds (default)
+      linear    the rate interpolates between consecutive points
+
+    Offered qps at time t = connections × ``rate_at(t)``. The schedule
+    (and the per-phase offered rates) land in the artifact so a journal
+    or metrics timeline can be joined against exactly when the load
+    moved."""
+
+    def __init__(self, points: list[tuple[float, float]],
+                 shape: str = "step") -> None:
+        if shape not in ("step", "linear"):
+            raise ValueError(f"ramp shape must be step|linear, got {shape!r}")
+        if not points:
+            raise ValueError("ramp needs at least one T:RATE point")
+        for (t0, _), (t1, _) in zip(points, points[1:]):
+            if t1 <= t0:
+                raise ValueError(
+                    f"ramp times must be strictly ascending ({t0} then {t1})"
+                )
+        for t, rate in points:
+            if t < 0 or rate <= 0:
+                raise ValueError(
+                    f"ramp points need t >= 0 and rate > 0, got {t}:{rate}"
+                )
+        self.points = list(points)
+        self.shape = shape
+
+    @classmethod
+    def parse(cls, spec: str, shape: str = "step") -> "_RateSchedule":
+        points = []
+        for term in spec.split(","):
+            t_s, sep, rate_s = term.strip().partition(":")
+            if not sep:
+                raise ValueError(
+                    f"bad ramp term {term.strip()!r}: expected T:RATE"
+                )
+            points.append((float(t_s), float(rate_s)))
+        return cls(points, shape=shape)
+
+    def rate_at(self, t: float) -> float:
+        pts = self.points
+        if t <= pts[0][0]:
+            return pts[0][1]
+        if self.shape == "step":
+            rate = pts[0][1]
+            for pt, prate in pts:
+                if t >= pt:
+                    rate = prate
+                else:
+                    break
+            return rate
+        for (t0, r0), (t1, r1) in zip(pts, pts[1:]):
+            if t <= t1:
+                return r0 + (r1 - r0) * (t - t0) / (t1 - t0)
+        return pts[-1][1]
+
+    def describe(self, connections: int) -> dict:
+        return {
+            "spec": ",".join(f"{t:g}:{r:g}" for t, r in self.points),
+            "shape": self.shape,
+            "points": [
+                {
+                    "t_s": t, "rate_per_conn": r,
+                    "offered_qps": round(connections * r, 1),
+                }
+                for t, r in self.points
+            ],
+        }
+
+
 def _percentiles(xs: list[float], qs=(50, 95, 99)) -> dict[str, float | None]:
     if not xs:
         # None → JSON null: a bare NaN token would make the artifact
@@ -609,7 +686,8 @@ class _EvConn:
 
 
 def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
-                      retry=_NO_RETRY, rate_per_conn: float = 0.0):
+                      retry=_NO_RETRY, rate_per_conn: float = 0.0,
+                      schedule: _RateSchedule | None = None):
     """Closed loop over ``connections`` persistent sockets driven by ONE
     selector thread — the client-side mirror of the server's event-loop
     transport. A thread-per-connection client melts into GIL scheduling
@@ -623,7 +701,10 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
     connections: the 1000-user SLO scenario — 1000 live keep-alive
     connections offering connections×rate qps — instead of the
     zero-think-time saturation mode, whose latency is pinned at
-    N/throughput by Little's law no matter how fast the server is."""
+    N/throughput by Little's law no matter how fast the server is.
+    ``schedule`` (``--ramp``) generalizes the constant rate to a
+    piecewise step/linear rate over the run — the surge→quiet arc from
+    one client."""
     import selectors
 
     u = urllib.parse.urlparse(url)
@@ -633,13 +714,20 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
     bodies.arm(t_start)
     tally.t0 = t_start
     stop = t_start + duration
-    interval = 1.0 / rate_per_conn if rate_per_conn > 0 else 0.0
+    if schedule is None and rate_per_conn > 0:
+        schedule = _RateSchedule([(0.0, rate_per_conn)])
+    paced = schedule is not None
+
+    def interval_at(now: float) -> float:
+        return 1.0 / schedule.rate_at(now - t_start)
+
     conns = [_EvConn() for _ in range(connections)]
-    if interval:
+    if paced:
+        first = interval_at(t_start)
         for i, c in enumerate(conns):
             # Staggered starts decorrelate the fleet (no thundering herd
             # at t=0 and none at each subsequent tick).
-            c.next_at = t_start + interval * i / max(connections, 1)
+            c.next_at = t_start + first * i / max(connections, 1)
 
     def connect(c: _EvConn) -> None:
         c.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -671,8 +759,8 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
             c.body = bodies.next_body()
             c.t0 = now
             c.attempt = 0
-            if interval:
-                c.next_at = max(c.next_at + interval, now)
+            if paced:
+                c.next_at = max(c.next_at + interval_at(now), now)
         c.deadline = now + timeout
         req = (
             b"POST /predict HTTP/1.1\r\n"
@@ -722,7 +810,7 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
         )
         c.requests_done += 1
         if now < stop:
-            if interval and c.next_at > now:
+            if paced and c.next_at > now:
                 # Paced mode: the connection idles (still connected, still
                 # keep-alive) until its next scheduled request.
                 c.backoff_until = c.next_at
@@ -737,7 +825,7 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
             c.closed = True
 
     for c in conns:
-        if interval and c.next_at > t_start:
+        if paced and c.next_at > t_start:
             c.backoff_until = c.next_at
             c.pending_new = True
         else:
@@ -990,6 +1078,20 @@ def main(argv=None) -> int:
         "zero-think-time saturation, whose latency is pinned at "
         "N/throughput by Little's law",
     )
+    ap.add_argument(
+        "--ramp", default=None, metavar="SPEC",
+        help="per-connection rate SCHEDULE for the paced --connections "
+        "client: comma-separated T:RATE points (seconds into the run : "
+        "requests/s per connection), e.g. '0:1,15:6,75:1' — one client "
+        "drives the whole surge→quiet arc; the schedule lands in the "
+        "artifact's ramp block. Requires --connections; mutually "
+        "exclusive with --rate-per-conn",
+    )
+    ap.add_argument(
+        "--ramp-shape", choices=("step", "linear"), default="step",
+        help="how the rate moves between --ramp points: step jumps and "
+        "holds (default), linear interpolates",
+    )
     ap.add_argument("--qps", type=float, default=100.0, help="open-loop rate")
     ap.add_argument("--timeout", type=float, default=30.0)
     ap.add_argument("--patient", help="patient JSON file (default: example)")
@@ -1064,6 +1166,19 @@ def main(argv=None) -> int:
     if args.rate_per_conn and not args.connections:
         ap.error("--rate-per-conn requires --connections (pacing is a "
                  "property of the event-loop client)")
+    schedule = None
+    if args.ramp:
+        if not args.connections:
+            ap.error("--ramp requires --connections (a ramp is a pacing "
+                     "schedule, and pacing is a property of the "
+                     "event-loop client)")
+        if args.rate_per_conn:
+            ap.error("--ramp and --rate-per-conn are mutually exclusive "
+                     "(a ramp IS the rate)")
+        try:
+            schedule = _RateSchedule.parse(args.ramp, shape=args.ramp_shape)
+        except ValueError as exc:
+            ap.error(f"--ramp: {exc}")
 
     if args.patients:
         with open(args.patients) as f:
@@ -1110,8 +1225,11 @@ def main(argv=None) -> int:
                 args.url, bodies, args.duration, args.concurrency,
                 args.timeout, tally, retry=retry,
                 rate_per_conn=args.rate_per_conn,
+                schedule=schedule,
             )
-            # Paced mode has a definite offered rate; saturation does not.
+            # Constant-paced mode has ONE definite offered rate; a ramp
+            # records its per-phase rates in the ramp block; saturation
+            # has none.
             offered = (
                 round(args.concurrency * args.rate_per_conn, 1)
                 if args.rate_per_conn else None
@@ -1172,6 +1290,12 @@ def main(argv=None) -> int:
         "patients": patients_src,
         "n_patients": len(patients),
         "perturb": bodies.describe(),
+        # The --ramp traffic shape (null without one): the schedule the
+        # client offered, for joining against journal/metrics timelines.
+        "ramp": (
+            schedule.describe(args.concurrency)
+            if schedule is not None else None
+        ),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
     }
     line = json.dumps(artifact, indent=1)

@@ -37,7 +37,13 @@ Sources (mix live and file freely; stdlib only):
                    routing counters — --url then points at the ROUTER
                    (fetches /healthz, /metrics?format=json,
                    /fleet/replicas, /debug/requests), or join a saved
-                   --metrics snapshot with the router's --journal
+                   --metrics snapshot with the router's --journal.
+                   When the journal set carries autoscaler/lifecycle
+                   events, an "Elastic fleet" section joins autoscale
+                   decisions, spawn/ready/drain/kill/respawn arcs, and
+                   rotation changes into one timeline (``--journal`` is
+                   repeatable — daemon, router, and replica journals
+                   merge by timestamp)
   --learn          render the "Continual learning" section
                    (docs/CONTINUAL.md): trigger decisions, refit stage
                    timings, the shadow verdict, the promotion/deploy
@@ -503,6 +509,86 @@ def _section_fleet(
         rep.table(("when", "model", "deploy arc"), rows)
 
 
+def _summ_signals(signals: dict | None) -> str:
+    if not isinstance(signals, dict):
+        return "-"
+    return ", ".join(
+        f"{k}={v}" for k, v in signals.items() if v is not None
+    ) or "-"
+
+
+def _section_autoscale(rep: Report, events: list[dict]):
+    """The "Elastic fleet" section: autoscaler decisions, lifecycle arcs
+    (spawn/ready/drain/term/kill/exit/crash), and router rotation
+    changes joined into ONE timeline across the daemon, router, and
+    replica journals — the answer to "what did the fleet's size do, and
+    why, at t" after a surge drill (docs/FLEET.md "Elastic fleet")."""
+    decisions = [e for e in events if e.get("kind") == "autoscale_decision"]
+    lifecycle = [
+        e for e in events if (e.get("kind") or "").startswith("lifecycle_")
+    ]
+    if not decisions and not lifecycle:
+        return
+    rep.h("Elastic fleet")
+    fired = [e for e in decisions if e.get("decision")]
+    rep.kv(
+        "autoscale decisions",
+        f"{len(fired)} fired, {len(decisions) - len(fired)} suppressed "
+        "(journaled)",
+    )
+    if fired:
+        rep.lines.append("")
+        rep.table(
+            ("when", "decision", "fleet", "reason", "signals"),
+            [
+                (
+                    e.get("ts"), e.get("decision"),
+                    f"{e.get('desired')} → {e.get('target')} "
+                    f"(ready {e.get('ready')})",
+                    e.get("reason"), _summ_signals(e.get("signals")),
+                )
+                for e in fired
+            ],
+        )
+    rotations = [e for e in events if e.get("kind") == "fleet_rotation"]
+    timeline = sorted(
+        decisions + lifecycle + rotations, key=lambda e: e.get("ts") or ""
+    )
+    if timeline:
+        rep.lines.append("")
+        rows = []
+        for e in timeline:
+            kind = e.get("kind")
+            if kind == "autoscale_decision":
+                source = "autoscaler"
+                what = (
+                    f"{e.get('decision')} → {e.get('target')} replicas "
+                    f"({e.get('reason')})"
+                    if e.get("decision") else
+                    f"suppressed by {e.get('suppressed_by')} "
+                    f"({e.get('reason')})"
+                )
+            elif kind == "fleet_rotation":
+                source = "router"
+                what = (
+                    f"{e.get('replica')} rotated {e.get('direction')} "
+                    f"({e.get('reason')})"
+                )
+            else:
+                source = "lifecycle"
+                detail = e.get("reason") or e.get("detail") or \
+                    e.get("error") or ""
+                what = kind.replace("lifecycle_", "") + \
+                    f": {e.get('replica')}" + (f" ({detail})" if detail
+                                               else "")
+                if kind == "lifecycle_ready":
+                    what += f" in {e.get('seconds')}s"
+                if kind == "lifecycle_exit" and e.get("code") is not None:
+                    what += f" exit {e.get('code')}"
+            rows.append((e.get("ts"), source, what))
+        rep.table(("when", "source", "event"), rows)
+
+
 def _section_learn(rep: Report, events: list[dict], bench: dict | None):
     """The "Continual learning" section: the closed loop's one joined
     story (docs/CONTINUAL.md) — trigger decisions, the refit's stage
@@ -900,6 +986,9 @@ def main(argv=None) -> int:
         _section_fleet(
             rep, fleet_replicas, (metrics or {}).get("runtime"), events,
         )
+        # The elastic-fleet timeline (autoscaler + lifecycle + rotation
+        # events joined) renders whenever the journal set carries it.
+        _section_autoscale(rep, events)
         _section_tail(rep, requests, n=args.tail)
         if args.journal:
             _section_journal(rep, events)
