@@ -847,6 +847,9 @@ def _run_fleet_router(args) -> int:
                 f"--replica expects ID=URL, got {spec!r}"
             )
         replicas.append((rid, url))
+    worker_id = getattr(args, "_worker_id", None)
+    if args.workers > 1 and worker_id is None:
+        return _run_router_multiworker(args)
     jrn = None
     if args.journal:
         # Deliberately not _observed: that path installs jax.monitoring
@@ -865,15 +868,16 @@ def _run_fleet_router(args) -> int:
         fail_threshold=args.fail_threshold,
         recover_probes=args.recover_probes,
         breaker_failures=args.breaker_failures,
-        forward_workers=args.forward_workers,
+        reuse_port=args.workers > 1,
         quiet=not args.verbose,
         capture_dir=args.capture,
         capture_rows_per_shard=args.capture_rows_per_shard,
         capture_max_shards=args.capture_max_shards,
     )
     host, port = handle.address
+    who = f" (worker {worker_id})" if worker_id is not None else ""
     print(
-        f"fleet router on http://{host}:{port} "
+        f"fleet router on http://{host}:{port}{who} "
         f"({len(replicas)} static replicas; POST /fleet/replicas to "
         "register more)",
         file=sys.stderr,
@@ -894,6 +898,91 @@ def _run_fleet_router(args) -> int:
             jrn.close()
             print(f"journal written to {jrn.path}", file=sys.stderr)
     return 0
+
+
+def _run_router_multiworker(args) -> int:
+    """Pre-fork ``SO_REUSEPORT`` multi-worker routing for many-core
+    hosts: N router processes each run their own loop (listener AND
+    upstream pool) on one shared port; the kernel spreads inbound
+    connections across them. Each worker keeps its own registry — the
+    replicas' periodic registration heartbeats (fresh connection per
+    beat, so the kernel rotates them across workers) converge every
+    worker's membership within a few beats, and static ``--replica``
+    seeds apply to all workers at fork. The parent only supervises,
+    exactly like ``cli serve --workers``."""
+    import signal
+
+    if args.port == 0:
+        raise SystemExit("--workers requires a fixed --port (not 0): "
+                         "all workers bind the same SO_REUSEPORT port")
+    if args.capture:
+        # N workers appending to one rotating shard window would
+        # interleave rotations and tear the capture contract; the tap
+        # stays a single-worker feature.
+        raise SystemExit("--capture is not supported with --workers > 1 "
+                         "(run a single-worker capture router)")
+    children: list[int] = []
+    for k in range(args.workers):
+        pid = os.fork()
+        if pid == 0:
+            rc = 1
+            try:
+                args._worker_id = k
+                if args.journal:
+                    args.journal = f"{args.journal}.w{k}"
+                rc = _run_fleet_router(args)
+            except SystemExit as exc:
+                rc = exc.code if isinstance(exc.code, int) else 1
+            except BaseException:
+                import traceback
+
+                traceback.print_exc()
+                rc = 1
+            finally:
+                os._exit(rc or 0)
+        children.append(pid)
+    print(
+        f"fleet router with {args.workers} SO_REUSEPORT workers on port "
+        f"{args.port} (pids {children})",
+        file=sys.stderr,
+    )
+    shutting_down = False
+
+    def _forward(signum, frame):
+        nonlocal shutting_down
+        shutting_down = True
+        for pid in children:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+    rc = 0
+    alive = set(children)
+    while alive:
+        try:
+            pid, status = os.waitpid(-1, 0)
+        except InterruptedError:
+            continue
+        except ChildProcessError:
+            break
+        if pid not in alive:
+            continue
+        alive.discard(pid)
+        code = (
+            os.WEXITSTATUS(status) if os.WIFEXITED(status)
+            else 128 + os.WTERMSIG(status)
+        )
+        rc = max(rc, code)
+        if code != 0 and not shutting_down and alive:
+            print(
+                f"router worker pid {pid} exited {code}; stopping the "
+                "rest", file=sys.stderr,
+            )
+            _forward(None, None)
+    return rc
 
 
 def _run_fleet_autoscale(args) -> int:
@@ -1691,9 +1780,11 @@ def build_parser() -> argparse.ArgumentParser:
         "(immediate rotation out; probes close it)",
     )
     fr.add_argument(
-        "--forward-workers", type=int, default=8,
-        help="upstream forwarder threads (each keeps one keep-alive "
-        "connection per replica)",
+        "--workers", type=int, default=1,
+        help="pre-fork N SO_REUSEPORT router processes on the shared "
+        "--port for many-core hosts; each worker owns its own event "
+        "loop (listener + upstream pool) and registry, converging "
+        "membership through the replicas' registration heartbeats",
     )
     fr.add_argument(
         "--journal", default=None,

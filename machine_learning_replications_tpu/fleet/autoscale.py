@@ -402,7 +402,15 @@ class AutoscaleDaemon:
             url = (rep.get("url") or "").rstrip("/")
             if not url:
                 continue
-            depth = replica_queue_depth(url, timeout_s=rep_timeout)
+            # Queue depth rides the router page since r17: the registry's
+            # per-replica load block carries the /readyz-probed depth the
+            # least-loaded balancer picks on, so the autoscaler reads the
+            # SAME view (docs/FLEET.md "Router data plane") and skips one
+            # HTTP fetch per replica per tick. The direct /healthz fetch
+            # stays as the fallback for a pre-r17 router page.
+            depth = (rep.get("load") or {}).get("last_queue_depth")
+            if depth is None:
+                depth = replica_queue_depth(url, timeout_s=rep_timeout)
             if depth is not None:
                 depths.append(float(depth))
             try:

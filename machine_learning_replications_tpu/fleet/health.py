@@ -26,10 +26,13 @@ import urllib.request
 
 
 def probe_replica(url: str, timeout_s: float = 2.0) -> dict:
-    """One ``/readyz`` probe: ``{"ok", "ready", "version"}``. ``ok``
-    is HTTP-level success (an explicit 503 is ok=True, ready=False —
-    the replica answered, and said no); transport failures are
-    ok=False. Never raises."""
+    """One ``/readyz`` probe: ``{"ok", "ready", "version",
+    "queue_depth"}``. ``ok`` is HTTP-level success (an explicit 503 is
+    ok=True, ready=False — the replica answered, and said no); transport
+    failures are ok=False. ``queue_depth`` (None when the replica
+    predates the field) feeds the registry's least-loaded score — the
+    probe the rotation already pays for doubles as the cross-router
+    load signal. Never raises."""
     try:
         with urllib.request.urlopen(
             url.rstrip("/") + "/readyz", timeout=timeout_s
@@ -38,6 +41,7 @@ def probe_replica(url: str, timeout_s: float = 2.0) -> dict:
         return {
             "ok": True, "ready": bool(body.get("ready")),
             "version": body.get("version"),
+            "queue_depth": body.get("queue_depth"),
         }
     except urllib.error.HTTPError as exc:
         try:
@@ -47,9 +51,11 @@ def probe_replica(url: str, timeout_s: float = 2.0) -> dict:
         return {
             "ok": True, "ready": bool(body.get("ready")),
             "version": body.get("version"),
+            "queue_depth": body.get("queue_depth"),
         }
     except Exception:
-        return {"ok": False, "ready": False, "version": None}
+        return {"ok": False, "ready": False, "version": None,
+                "queue_depth": None}
 
 
 class HealthProber:
@@ -83,6 +89,7 @@ class HealthProber:
             self.registry.observe_probe(
                 replica_id, ok=verdict["ok"], ready=verdict["ready"],
                 version=verdict["version"],
+                queue_depth=verdict.get("queue_depth"),
             )
 
     def _loop(self) -> None:

@@ -20,6 +20,29 @@ an explicit rotation state machine, fed by two independent signals —
     the requests ARE the probe when traffic is flowing. Recovery is
     probe-driven like any other out state.
 
+**Least-loaded rotation** (power-of-two-choices): ``pick`` no longer
+walks a round-robin ring. Each replica carries three live load signals —
+
+  * ``outstanding``: upstream attempts dispatched by THIS router and not
+    yet answered (``note_dispatch`` / ``note_complete``),
+  * ``ewma_latency_ms``: an exponentially weighted moving average of this
+    router's observed attempt latencies (``note_complete``),
+  * ``last_queue_depth``: the replica's own admission-queue depth, read
+    off ``/readyz`` by the prober (``observe_probe``) — the shared
+    signal that also sees load from OTHER routers (``--workers N``
+    router processes each run their own registry).
+
+``pick`` samples TWO distinct in-rotation candidates uniformly at random
+and takes the lower-scored one (``score = ewma_latency × (1 +
+outstanding + queue_depth)``); ties (e.g. an idle fleet with no signal
+yet) break to the replica picked least recently, so cold fleets still
+spread. Two random choices instead of a global arg-min is deliberate:
+full least-loaded herds every router worker onto the same momentarily
+idle replica between signal refreshes, while two choices gets
+exponentially better load balance than random for one extra sample
+(Mitzenmacher) with no herding — and never scans the fleet under the
+lock.
+
 An **admin hold** (``hold`` / ``release``) is orthogonal to probe state:
 the rolling-deploy controller holds a replica while its new version
 warms, which removes it from ``pick`` without touching the probe state
@@ -37,6 +60,7 @@ and must start in milliseconds, not after an XLA backend init.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 
@@ -80,6 +104,8 @@ class Replica:
         "id", "url", "state", "reason", "version", "held",
         "probe_fails", "probe_oks", "request_fails",
         "registered_at", "last_probe_at", "last_change_at",
+        "outstanding", "ewma_latency_ms", "last_queue_depth",
+        "last_pick_seq",
     )
 
     def __init__(self, replica_id: str, url: str) -> None:
@@ -95,6 +121,35 @@ class Replica:
         self.registered_at = time.time()
         self.last_probe_at: float | None = None
         self.last_change_at = self.registered_at
+        # Load signals driving least-loaded picking (module docstring).
+        self.outstanding = 0
+        self.ewma_latency_ms: float | None = None
+        self.last_queue_depth: int | None = None
+        self.last_pick_seq = 0  # LRU tie-break for the cold fleet
+
+    #: Latency prior (ms) for a replica with no sample yet: low enough
+    #: that exploration beats any realistically-warm replica's score, so
+    #: a fresh replica is never starved — but NOT near-zero, so the
+    #: load factor still caps the exploration burst. Against a warm
+    #: replica idling at W ms, a cold replica stops winning once its
+    #: outstanding count passes ~W/0.25 (e.g. ~20 in-flight at 5 ms,
+    #: ~400 at 100 ms): a bounded probe window, not the whole in-flight
+    #: load of a 1000-connection router piling onto one cold engine.
+    LATENCY_PRIOR_MS = 0.25
+
+    def score(self) -> float:
+        """Expected-cost score for power-of-two-choices: recent latency
+        scaled by everything already queued at (or in flight to) the
+        replica. A replica with no latency sample yet scores on the
+        exploration prior above — sampled quickly, never starved, and
+        never handed an unbounded cold-start burst."""
+        lat = (
+            max(self.ewma_latency_ms, 1e-3)
+            if self.ewma_latency_ms is not None else self.LATENCY_PRIOR_MS
+        )
+        return lat * (
+            1.0 + self.outstanding + (self.last_queue_depth or 0)
+        )
 
     def as_dict(self) -> dict:
         return {
@@ -109,6 +164,18 @@ class Replica:
             "request_fails": self.request_fails,
             "registered_at": self.registered_at,
             "last_probe_at": self.last_probe_at,
+            # The load view the balancer picks on (docs/FLEET.md "Router
+            # data plane") — operators and the autoscaler read the same
+            # numbers that drive rotation.
+            "load": {
+                "ewma_latency_ms": (
+                    None if self.ewma_latency_ms is None
+                    else round(self.ewma_latency_ms, 3)
+                ),
+                "outstanding": self.outstanding,
+                "last_queue_depth": self.last_queue_depth,
+                "score": round(self.score(), 3),
+            },
         }
 
 
@@ -121,11 +188,17 @@ class ReplicaRegistry:
     failures that rotate a replica out immediately.
     """
 
+    #: EWMA smoothing for observed attempt latency: ~the last 10
+    #: attempts dominate, so one slow outlier decays within a dozen
+    #: requests instead of poisoning the replica's score for minutes.
+    EWMA_ALPHA = 0.2
+
     def __init__(
         self,
         fail_threshold: int = 2,
         recover_probes: int = 2,
         breaker_failures: int = 3,
+        rng: random.Random | None = None,
     ) -> None:
         if min(fail_threshold, recover_probes, breaker_failures) < 1:
             raise ValueError("thresholds must be >= 1")
@@ -134,7 +207,8 @@ class ReplicaRegistry:
         self.breaker_failures = int(breaker_failures)
         self._lock = threading.Lock()
         self._replicas: dict[str, Replica] = {}
-        self._rr = 0  # round-robin cursor over the ready list
+        self._rng = rng or random.Random()
+        self._pick_seq = 0  # monotonic pick stamp (LRU tie-break)
 
     # -- membership ---------------------------------------------------------
 
@@ -212,25 +286,66 @@ class ReplicaRegistry:
             )
 
     def pick(self, exclude: set[str] | None = None) -> dict | None:
-        """The next in-rotation replica, round-robin, preferring ones not
-        in ``exclude`` (the retry path's already-tried set). Falls back
-        to an excluded-but-ready replica when nothing else is in rotation
-        — against a shrunken fleet, retrying the same replica beats
-        failing the request outright. None when nothing is ready."""
+        """The least-loaded of two random in-rotation choices (module
+        docstring), preferring replicas not in ``exclude`` (the retry
+        path's already-tried set). Falls back to an excluded-but-ready
+        replica when nothing else is in rotation — against a shrunken
+        fleet, retrying the same replica beats failing the request
+        outright. None when nothing is ready."""
         with self._lock:
             ready = [
-                rep for _, rep in sorted(self._replicas.items())
+                rep for rep in self._replicas.values()
                 if rep.state == READY and not rep.held
             ]
             if not ready:
                 return None
-            fresh = [
+            pool = [
                 rep for rep in ready
                 if not exclude or rep.id not in exclude
-            ]
-            pool = fresh or ready
-            self._rr = (self._rr + 1) % len(pool)
-            return pool[self._rr].as_dict()
+            ] or ready
+            if len(pool) == 1:
+                chosen = pool[0]
+            else:
+                a, b = self._rng.sample(pool, 2)
+                sa, sb = a.score(), b.score()
+                if sa != sb:
+                    chosen = a if sa < sb else b
+                else:
+                    # No signal separates them (cold fleet): take the
+                    # one picked least recently so traffic still spreads.
+                    chosen = a if a.last_pick_seq <= b.last_pick_seq \
+                        else b
+            self._pick_seq += 1
+            chosen.last_pick_seq = self._pick_seq
+            return chosen.as_dict()
+
+    def note_dispatch(self, replica_id: str) -> None:
+        """An upstream attempt is in flight to the replica: its
+        ``outstanding`` count — the most immediate load signal there is
+        — rises until ``note_complete``."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is not None:
+                rep.outstanding += 1
+
+    def note_complete(self, replica_id: str,
+                      latency_s: float | None = None) -> None:
+        """The attempt finished (any outcome). ``latency_s`` feeds the
+        EWMA only when the replica actually answered — a conn-error's
+        instant failure or a timeout's capped wait says nothing about
+        how fast the replica serves."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                return
+            rep.outstanding = max(0, rep.outstanding - 1)
+            if latency_s is not None:
+                ms = latency_s * 1000.0
+                if rep.ewma_latency_ms is None:
+                    rep.ewma_latency_ms = ms
+                else:
+                    a = self.EWMA_ALPHA
+                    rep.ewma_latency_ms += a * (ms - rep.ewma_latency_ms)
 
     def mark_success(self, replica_id: str) -> None:
         """A routed request succeeded: the failure streak resets."""
@@ -304,12 +419,15 @@ class ReplicaRegistry:
     def observe_probe(
         self, replica_id: str, ok: bool, ready: bool,
         version: int | None = None,
+        queue_depth: int | None = None,
     ) -> None:
         """Prober feedback for one replica: ``ok`` means the probe got an
         HTTP answer at all, ``ready`` the replica's own readiness verdict
         (an explicit 503 is a *healthy* not-ready, e.g. draining — it
         still counts against rotation, but as ``not_ready`` rather than
-        a transport failure)."""
+        a transport failure). ``queue_depth`` is the replica's own
+        admission-queue depth off the same probe — the cross-router load
+        signal ``pick`` folds into its score."""
         FLEET_PROBES.inc(
             result="ok" if ok and ready else
             "not_ready" if ok else "error"
@@ -321,6 +439,17 @@ class ReplicaRegistry:
             rep.last_probe_at = time.time()
             if ok and version is not None:
                 rep.version = version
+            if ok and queue_depth is not None:
+                # The field arrives off an UNTRUSTED /readyz body (any
+                # process can register via the control plane): a
+                # non-numeric value must not abort the probe pass — it
+                # would freeze probing for every replica behind this one
+                # in the tick, including rotated-out ones waiting to
+                # recover.
+                try:
+                    rep.last_queue_depth = max(0, int(queue_depth))
+                except (TypeError, ValueError):
+                    pass
             if ok and ready:
                 rep.probe_fails = 0
                 rep.probe_oks += 1

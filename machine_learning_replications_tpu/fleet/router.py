@@ -7,15 +7,22 @@ application behind it: instead of an engine, a **replica registry**
 (``fleet.registry``), a **health prober** (``fleet.health``), and a
 **proxy data path** with per-request retry and hedging.
 
-Data path (``POST /predict``):
+Data path (``POST /predict``) — ONE loop thread owns every socket end
+to end, client side and replica side:
 
-  * The handler (event-loop thread) picks an in-rotation replica
-    (round-robin, per-replica breakers skipped) and hands the attempt to
-    a small forwarder thread pool — upstream I/O never blocks the loop.
-    Each forwarder keeps one persistent keep-alive connection per
-    replica (the loadgen lesson: no per-request TCP handshake on the
-    hot path), with one transparent fresh-connection resend when a
-    reused socket died idle.
+  * The handler (event-loop thread) picks an in-rotation replica —
+    **least-loaded, power-of-two-choices** over the registry's live
+    per-replica signals (EWMA attempt latency × (1 + outstanding
+    attempts + queue depth); see ``fleet.registry``) — and fires the
+    attempt through the transport's ``UpstreamPool``: non-blocking
+    connect, per-replica keep-alive connection reuse, incremental
+    response parsing, write backpressure, and the strict
+    poisoned-connection rules a proxy needs. No thread hand-off per
+    request anywhere on the path: the attempt completes as a loop
+    callback, exactly like the timers it races. (The previous data
+    plane proxied through a small pool of forwarder threads holding
+    blocking ``http.client`` upstreams — the same thread-per-request
+    architecture whose removal replica-side bought 10.1×.)
   * The client's deadline (``--request-timeout``, tightened by an
     inbound ``X-Request-Deadline-Ms``, never loosened) rides DOWN to the
     replica as the remaining budget and is enforced router-side by a
@@ -32,12 +39,19 @@ Data path (``POST /predict``):
     re-sends and duplicates cannot double-apply anything.
   * **Hedging** (``hedge_ms`` > 0): when the first attempt has not
     answered within the hedge delay and a second in-rotation replica
-    exists, a duplicate fires; the first reply wins, the loser is
-    discarded. Tail latency from one slow replica costs one duplicate
-    request instead of a client-visible stall.
+    exists, a duplicate fires; the first reply wins, the loser's
+    attempt is cancelled (its connection closes — a half-spoken
+    exchange can never be pooled). Tail latency from one slow replica
+    costs one duplicate request instead of a client-visible stall.
   * Replies pass through the replica's body and identity headers
     (``X-Replica`` / ``X-Model-Version`` / ``X-Serve-Path``) — the
     rolling-deploy crossover is provable from the client side.
+
+For many-core hosts, ``cli fleet router --workers N`` forks N router
+processes sharing one ``SO_REUSEPORT`` port (``make_router(reuse_port=
+True)``), each with its own registry converging through the replicas'
+periodic registration heartbeats; the replica-side queue-depth probe
+signal keeps their load views consistent.
 
 Control plane: ``/fleet/replicas`` (GET snapshot; POST register /
 deregister — ``cli serve --register`` posts here), ``/fleet/deploy``
@@ -58,14 +72,17 @@ import json
 import queue
 import threading
 import time
+import urllib.parse
 
 from machine_learning_replications_tpu.obs import journal, reqtrace
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.fleet.health import HealthProber
 from machine_learning_replications_tpu.fleet.registry import ReplicaRegistry
+from machine_learning_replications_tpu.serve import protocol
 from machine_learning_replications_tpu.serve.metrics import LATENCY_BUCKETS_S
 from machine_learning_replications_tpu.serve.transport import (
     EventLoopHttpServer,
+    UpstreamPool,
 )
 
 FLEET_REQUESTS = REGISTRY.counter(
@@ -109,112 +126,112 @@ FLEET_DEPLOYS = REGISTRY.counter(
     "Rolling deploys driven through this router by result.",
     labels=("result",),
 )
+FLEET_UPSTREAM_CONNS = REGISTRY.counter(
+    "fleet_upstream_connections_total",
+    "Upstream connection events on the router's loop-owned pool "
+    "(opened: fresh TCP connect; reused: attempt rode a pooled "
+    "keep-alive connection).",
+    labels=("event",),
+)
 for _outcome in ("ok", "shed", "error", "timeout", "no_replica"):
     FLEET_REQUESTS.labels(outcome=_outcome)
+for _event in ("opened", "reused"):
+    FLEET_UPSTREAM_CONNS.labels(event=_event)
 FLEET_HEDGES.get()
 FLEET_HEDGE_WINS.get()
 
+# Child instruments resolved ONCE: labels() takes the family lock and
+# rebuilds the key tuple per call — measurable on the loop at four-digit
+# qps (the r11 SLOTracker lesson, applied to the router's hot counters).
+_REQ_OUTCOME = {
+    o: FLEET_REQUESTS.labels(outcome=o)
+    for o in ("ok", "shed", "error", "timeout", "no_replica",
+              "bad_request")
+}
+_UP_RESULT = {
+    r: FLEET_UPSTREAM.labels(result=r)
+    for r in ("ok", "shed", "server_error", "conn_error", "client_error")
+}
+_CONN_EVENT = {
+    e: FLEET_UPSTREAM_CONNS.labels(event=e) for e in ("opened", "reused")
+}
+_LATENCY = FLEET_LATENCY.get()
+_REPLICA_RESULT: dict = {}  # (replica, result) -> child counter
 
-class _Forwarders:
-    """Small pool of daemon threads running upstream calls — the proxy's
-    answer to 'handlers must not block the loop'. Each thread caches one
-    persistent keep-alive connection per (replica id, url)."""
 
-    def __init__(self, workers: int = 8) -> None:
-        self._q: queue.SimpleQueue = queue.SimpleQueue()
-        self._local = threading.local()
-        self._threads = [
-            threading.Thread(
-                target=self._loop, name=f"fleet-forward-{i}", daemon=True
-            )
-            for i in range(max(1, int(workers)))
-        ]
-        for t in self._threads:
-            t.start()
+def _replica_counter(replica: str, result: str):
+    child = _REPLICA_RESULT.get((replica, result))
+    if child is None:
+        child = _REPLICA_RESULT[(replica, result)] = \
+            FLEET_REPLICA_REQUESTS.labels(replica=replica, result=result)
+    return child
 
-    def submit(self, fn) -> None:
-        self._q.put(fn)
+
+FLEET_CAPTURE_DROPPED = REGISTRY.counter(
+    "fleet_capture_dropped_total",
+    "Served bodies dropped by the capture feed because the writer "
+    "thread fell behind (bounded hand-off queue; the capture window is "
+    "a bounded recent-cohort ring, so shedding is semantically fine).",
+)
+
+
+class _CaptureFeed:
+    """The continual-learning tap's hand-off: the loop thread must not
+    pay shard-rotation fsyncs, so captured bodies queue to one daemon
+    writer thread (the same reasoning as serve's AsyncQualityFeed).
+    The queue is BOUNDED: a disk slower than the request rate sheds
+    capture rows (counted) instead of growing router memory without
+    bound — the tap must never take the data path down, including by
+    OOM."""
+
+    MAX_PENDING = 8192
+
+    def __init__(self, capture) -> None:
+        self.capture = capture
+        self._q: queue.Queue = queue.Queue(maxsize=self.MAX_PENDING)
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-capture", daemon=True
+        )
+        self._thread.start()
+
+    def append(self, body: bytes) -> None:
+        try:
+            self._q.put_nowait(body)
+        except queue.Full:
+            FLEET_CAPTURE_DROPPED.get().inc()
 
     def _loop(self) -> None:
         while True:
-            fn = self._q.get()
-            if fn is None:
-                self._q.put(None)  # let the other workers see it too
+            item = self._q.get()
+            if item is None:
                 return
             try:
-                fn()
+                self.capture.append_line(item)
             except Exception:
-                pass  # a forwarded attempt must never kill a worker
+                pass  # the data tap must never take the data path down
 
     def close(self) -> None:
         self._q.put(None)
-
-    # -- per-thread keep-alive connections ----------------------------------
-
-    def call(
-        self, replica_id: str, url: str, method: str, path: str,
-        body: bytes | None, headers: dict[str, str], timeout_s: float,
-    ) -> tuple[int, dict[str, str], bytes]:
-        """One upstream HTTP call over this thread's cached connection to
-        the replica; a dead reused socket gets one transparent fresh
-        connection. Raises ``OSError``/``http.client`` errors on
-        transport failure (the caller classifies)."""
-        import http.client
-        import urllib.parse
-
-        cache = getattr(self._local, "conns", None)
-        if cache is None:
-            cache = self._local.conns = {}
-        key = (replica_id, url)
-        conn = cache.get(key)
-        fresh = conn is None
-        if fresh:
-            u = urllib.parse.urlparse(url)
-            conn = http.client.HTTPConnection(
-                u.hostname or "127.0.0.1", u.port or 80, timeout=timeout_s
-            )
-            cache[key] = conn
-        conn.timeout = timeout_s
-        try:
-            return self._once(conn, method, path, body, headers)
-        except (http.client.HTTPException, OSError):
-            conn.close()
-            if fresh:
-                cache.pop(key, None)
-                raise
-            # Reused socket died (idle reap, replica restart): one resend
-            # on a fresh connection before the failure becomes real.
-            try:
-                return self._once(conn, method, path, body, headers)
-            except (http.client.HTTPException, OSError):
-                conn.close()
-                cache.pop(key, None)
-                raise
-
-    @staticmethod
-    def _once(conn, method, path, body, headers):
-        conn.request(method, path, body=body, headers=headers)
-        resp = conn.getresponse()
-        data = resp.read()
-        hdrs = {k.lower(): v for k, v in resp.getheaders()}
-        if hdrs.get("connection", "").lower() == "close" or resp.will_close:
-            conn.close()
-        return resp.status, hdrs, data
+        self._thread.join(timeout=10.0)
+        self.capture.close()
 
 
 _PASSTHROUGH_HEADERS = ("x-replica", "x-model-version", "x-serve-path")
 
 
 class _ProxyJob:
-    """One routed /predict request: the race between upstream attempts
-    (forwarder threads), the hedge timer, and the deadline timer (loop
-    thread) resolves under one lock — exactly one of them replies."""
+    """One routed /predict request — a state machine that lives entirely
+    ON the loop thread: dispatches are ``UpstreamPool`` attempts whose
+    completions come back as loop callbacks, racing the hedge and
+    deadline timers on the same clock. No locks — admission, every
+    retry, the hedge, the deadline, and the reply are serialized by the
+    loop by construction; exactly one path flips ``done``."""
 
     __slots__ = (
         "app", "trace", "responder", "body", "pin", "deadline_mono",
         "deadline_s", "tried", "first_replica", "attempts", "hedged",
-        "t_route0", "deadline_timer", "hedge_timer", "_done", "_lock",
-        "last_retry_after",
+        "t_route0", "deadline_timer", "hedge_timer", "done",
+        "last_retry_after", "pending",
     )
 
     def __init__(self, app, trace, responder, body: bytes,
@@ -234,17 +251,31 @@ class _ProxyJob:
         self.deadline_timer = None
         self.hedge_timer = None
         self.last_retry_after: str | None = None
-        self._done = False
-        self._lock = threading.Lock()
+        self.pending: list = []  # in-flight UpstreamAttempts
+        self.done = False
 
     def _claim(self) -> bool:
-        with self._lock:
-            if self._done:
-                return False
-            self._done = True
-            return True
+        if self.done:
+            return False
+        self.done = True
+        self._settle()
+        return True
 
-    # -- admission / dispatch (loop thread first, then any thread) -----------
+    def _settle(self) -> None:
+        """Terminal cleanup: stop the timers and cancel the losing
+        in-flight attempts (their connections close — a reply may be
+        mid-flight on them). A cancelled attempt's completion never
+        fires, so its replica's outstanding count is released here."""
+        if self.deadline_timer is not None:
+            self.deadline_timer.cancel()
+        if self.hedge_timer is not None:
+            self.hedge_timer.cancel()
+        for att in self.pending:
+            if att.cancel():
+                self.app.registry.note_complete(att.key, None)
+        self.pending.clear()
+
+    # -- admission / dispatch (loop thread) ----------------------------------
 
     def start(self) -> None:
         rep = self.app.registry.pick()
@@ -263,7 +294,6 @@ class _ProxyJob:
     def finish_no_replica(self) -> None:
         if not self._claim():
             return
-        self._cancel_timers()
         self.app.finish(
             self, "no_replica", 503,
             body=json.dumps({"error": "no ready replicas"}).encode(),
@@ -271,14 +301,45 @@ class _ProxyJob:
         )
 
     def dispatch(self, rep: dict) -> None:
-        with self._lock:
-            if self._done:
-                return
-            self.attempts += 1
-            if self.first_replica is None:
-                self.first_replica = rep["id"]
-            self.tried.add(rep["id"])
-        self.app.forwarders.submit(lambda: self.attempt(rep))
+        if self.done:
+            return
+        self.attempts += 1
+        if self.first_replica is None:
+            self.first_replica = rep["id"]
+        self.tried.add(rep["id"])
+        self._send(rep)
+
+    def _send(self, rep: dict) -> None:
+        """Fire one upstream attempt through the loop-owned pool."""
+        remaining = self.deadline_mono - time.monotonic()
+        if remaining <= 0.005:
+            return  # the deadline timer answers
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": self.trace.request_id,
+            # The remaining budget rides down so the replica's own
+            # deadline machinery (504 + cancel-unflushed) is in play for
+            # exactly the time the client is still listening.
+            "X-Request-Deadline-Ms": str(int(remaining * 1000)),
+        }
+        if self.pin:
+            headers["X-Serve-Path"] = self.pin
+        data = protocol.build_request(
+            "POST", "/predict", headers, self.body,
+            host=f"{rep['id']}",
+        )
+        self.app.registry.note_dispatch(rep["id"])
+        t0 = time.monotonic()
+        cell: list = []
+        att = self.app.upstream.request(
+            rep["id"], self.app.replica_addr(rep["url"]), data,
+            timeout_s=remaining,
+            on_done=lambda result: self.on_upstream(
+                rep, t0, cell[0] if cell else None, result
+            ),
+        )
+        cell.append(att)
+        self.pending.append(att)
 
     def retry(self, reason: str, failed: dict) -> bool:
         """Pick another replica and re-send; False when the retry budget
@@ -300,8 +361,6 @@ class _ProxyJob:
     def on_deadline(self) -> None:
         if not self._claim():
             return
-        if self.hedge_timer is not None:
-            self.hedge_timer.cancel()
         self.app.finish(
             self, "timeout", 504,
             body=json.dumps({
@@ -321,70 +380,72 @@ class _ProxyJob:
         ``max_attempts`` — with the cap already spent, firing one would
         exceed the operator's per-request attempt budget exactly when
         the fleet is slow."""
-        with self._lock:
-            if self._done or self.hedged:
-                return
-            if self.attempts >= self.app.max_attempts:
-                return
-            rep = self.app.registry.pick(exclude=self.tried)
-            if rep is None or rep["id"] in self.tried:
-                return
-            self.hedged = True
+        if self.done or self.hedged:
+            return
+        if self.attempts >= self.app.max_attempts:
+            return
+        rep = self.app.registry.pick(exclude=self.tried)
+        if rep is None or rep["id"] in self.tried:
+            return
+        self.hedged = True
         FLEET_HEDGES.inc()
         self.trace.note(hedged=True)
         self.dispatch(rep)
 
-    # -- the upstream attempt (forwarder thread) ------------------------------
+    # -- the upstream completion (loop thread) --------------------------------
 
-    def attempt(self, rep: dict) -> None:
-        if self._done:
-            return
-        remaining = self.deadline_mono - time.monotonic()
-        if remaining <= 0.005:
-            return  # the deadline timer answers
-        headers = {
-            "Content-Type": "application/json",
-            "X-Request-Id": self.trace.request_id,
-            # The remaining budget rides down so the replica's own
-            # deadline machinery (504 + cancel-unflushed) is in play for
-            # exactly the time the client is still listening.
-            "X-Request-Deadline-Ms": str(int(remaining * 1000)),
-        }
-        if self.pin:
-            headers["X-Serve-Path"] = self.pin
-        try:
-            code, up_headers, data = self.app.forwarders.call(
-                rep["id"], rep["url"], "POST", "/predict", self.body,
-                headers, timeout_s=remaining,
-            )
-        except Exception as exc:
+    def on_upstream(self, rep: dict, t0: float, att, result) -> None:
+        """One attempt resolved: ``result`` is a ``protocol.
+        HttpResponse`` or an ``UpstreamError``. The replica's load
+        signals settle first (outstanding always; latency only when it
+        actually answered), then the retry/hedge/deadline race."""
+        rid = rep["id"]
+        answered = not isinstance(result, Exception)
+        self.app.registry.note_complete(
+            rid, (time.monotonic() - t0) if answered else None
+        )
+        if att is not None:
+            if att in self.pending:
+                self.pending.remove(att)
+            # One pooled ride per reused attempt; one fresh TCP connect
+            # per non-reused start AND per transparent resend (a fresh
+            # attempt that got resent opened TWO connections) — kept
+            # equal to the pool's own opened/reused totals so /metrics
+            # and /healthz tell one story.
+            if att.reused:
+                _CONN_EVENT["reused"].inc()
+            opened = (0 if att.reused else 1) + (1 if att.resent else 0)
+            if opened:
+                _CONN_EVENT["opened"].inc(opened)
+        if not answered:
             self._upstream_result(rep, "conn_error")
             self.app.registry.mark_failure(
-                rep["id"], f"{type(exc).__name__}: {exc}"
+                rid, f"{type(result).__name__}: {result}"
             )
+            if self.done:
+                return
             if not self.retry("conn_error", rep) and self._claim():
-                self._cancel_timers()
                 self.app.finish(
                     self, "error", 503,
                     body=json.dumps({
                         "error": "no replica answered "
-                        f"(last: {type(exc).__name__})",
+                        f"(last: {type(result).__name__})",
                     }).encode(),
-                    headers={"Retry-After": "1"}, replica=rep["id"],
+                    headers={"Retry-After": "1"}, replica=rid,
                 )
             return
+        code, up_headers, data = result.code, result.headers, result.body
         if code == 200:
             self._upstream_result(rep, "ok")
-            self.app.registry.mark_success(rep["id"])
-            won_hedge = self.hedged and rep["id"] != self.first_replica
+            self.app.registry.mark_success(rid)
+            won_hedge = self.hedged and rid != self.first_replica
             if not self._claim():
                 return  # the other attempt (or the deadline) answered
             if won_hedge:
                 FLEET_HEDGE_WINS.inc()
-            self._cancel_timers()
             self.app.finish(
                 self, "ok", 200, body=data, upstream_headers=up_headers,
-                replica=rep["id"],
+                replica=rid,
             )
             return
         if code == 503:
@@ -394,33 +455,34 @@ class _ProxyJob:
             # or degraded mode) — not a breaker strike; the prober
             # rotates it out if /readyz agrees. Prefer another replica
             # right now.
+            if self.done:
+                return
             if self.retry("shed", rep):
                 return
             if self._try_backoff_retry(rep):
                 return
             if self._claim():
-                self._cancel_timers()
                 self.app.finish(
                     self, "shed", 503, body=data,
-                    upstream_headers=up_headers, replica=rep["id"],
+                    upstream_headers=up_headers, replica=rid,
                 )
             return
         if code >= 500:
-            result = "server_error"
-            self._upstream_result(rep, result)
+            self._upstream_result(rep, "server_error")
             if code != 504:
                 # A 504 is the replica's own deadline verdict on THIS
                 # request — most of the budget is gone, and the miss says
                 # nothing about the replica's health.
-                self.app.registry.mark_failure(rep["id"], f"http_{code}")
+                self.app.registry.mark_failure(rid, f"http_{code}")
+                if self.done:
+                    return
                 if self.retry("server_error", rep):
                     return
             if self._claim():
-                self._cancel_timers()
                 self.app.finish(
                     self, "timeout" if code == 504 else "error", code,
                     body=data, upstream_headers=up_headers,
-                    replica=rep["id"],
+                    replica=rid,
                 )
             return
         # 4xx: the client's fault travels back unchanged — a malformed
@@ -428,10 +490,9 @@ class _ProxyJob:
         # burn fleet capacity on garbage.
         self._upstream_result(rep, "client_error")
         if self._claim():
-            self._cancel_timers()
             self.app.finish(
                 self, "bad_request", code, body=data,
-                upstream_headers=up_headers, replica=rep["id"],
+                upstream_headers=up_headers, replica=rid,
             )
 
     def _try_backoff_retry(self, rep: dict) -> bool:
@@ -448,29 +509,22 @@ class _ProxyJob:
         wait_s = max(0.05, wait_s)
         if time.monotonic() + wait_s >= self.deadline_mono - 0.05:
             return False
-        with self._lock:
-            if self._done:
-                return True
-            self.attempts += 1
+        self.attempts += 1
         FLEET_RETRIES.inc(reason="shed_backoff")
 
         def fire():
+            if self.done:
+                return
             target = self.app.registry.pick() or rep
-            self.app.forwarders.submit(lambda: self.attempt(target))
+            self._send(target)
 
-        self.app.call_later_threadsafe(wait_s, fire)
+        self.app.httpd.call_later(wait_s, fire)
         return True
-
-    def _cancel_timers(self) -> None:
-        if self.deadline_timer is not None:
-            self.deadline_timer.cancel()
-        if self.hedge_timer is not None:
-            self.hedge_timer.cancel()
 
     @staticmethod
     def _upstream_result(rep: dict, result: str) -> None:
-        FLEET_UPSTREAM.inc(result=result)
-        FLEET_REPLICA_REQUESTS.inc(replica=rep["id"], result=result)
+        _UP_RESULT[result].inc()
+        _replica_counter(rep["id"], result).inc()
 
 
 class _RouterApp:
@@ -481,21 +535,26 @@ class _RouterApp:
                  hedge_s: float, max_attempts: int, quiet: bool) -> None:
         self.handle = handle
         self.registry = handle.registry
-        self.forwarders = handle.forwarders
         self.recorder = handle.recorder
         self.request_timeout_s = float(request_timeout_s)
         self.hedge_s = float(hedge_s)
         self.max_attempts = int(max_attempts)
         self.quiet = quiet
-        self.httpd = None  # bound by make_router after the listener exists
+        # Both bound by make_router after the listener exists.
+        self.httpd = None
+        self.upstream: UpstreamPool | None = None
+        self._addrs: dict[str, tuple[str, int]] = {}
         self.started_at = time.time()
 
-    # -- loop helpers --------------------------------------------------------
-
-    def call_later_threadsafe(self, delay_s: float, fn) -> None:
-        """``call_later`` from any thread: posted onto the loop, where
-        timer creation is legal."""
-        self.httpd._post(lambda: self.httpd.call_later(delay_s, fn))
+    def replica_addr(self, url: str) -> tuple[str, int]:
+        """Replica url → (host, port), cached — one urlparse per replica
+        lifetime instead of one per attempt on the loop."""
+        addr = self._addrs.get(url)
+        if addr is None:
+            u = urllib.parse.urlparse(url)
+            addr = self._addrs[url] = (u.hostname or "127.0.0.1",
+                                       u.port or 80)
+        return addr
 
     # -- transport interface -------------------------------------------------
 
@@ -579,18 +638,17 @@ class _RouterApp:
             "ok" if outcome == "ok" else outcome,
             error=None if outcome == "ok" else f"http_{code}",
         )
-        FLEET_REQUESTS.inc(outcome=outcome)
-        FLEET_LATENCY.get().observe(trace.total_s)
+        _REQ_OUTCOME[outcome].inc()
+        _LATENCY.observe(trace.total_s)
         self.recorder.record(trace)
-        if self.handle.capture is not None and outcome == "ok":
+        if self.handle.capture_feed is not None and outcome == "ok":
             # Continual-learning tap (learn.capture): every SERVED row
             # lands in the bounded recent-cohort window. Raw bytes, no
-            # parse — validation happens once, at refit time. After the
-            # reply is written: capture latency is never client latency.
-            try:
-                self.handle.capture.append_line(job.body)
-            except Exception:
-                pass  # the data tap must never take the data path down
+            # parse — validation happens once, at refit time. Queued to
+            # the feed's writer thread: the loop never pays a shard
+            # rotation's fsync, and capture latency is never client
+            # latency.
+            self.handle.capture_feed.append(job.body)
 
     # -- control plane --------------------------------------------------------
 
@@ -611,6 +669,14 @@ class _RouterApp:
                 "capture": (
                     self.handle.capture.stats()
                     if self.handle.capture is not None else None
+                ),
+                # The loop-owned upstream pool: connection reuse is the
+                # data plane's health in one glance (opened ≈ replicas
+                # means keep-alive held; opened ≈ requests means it
+                # didn't).
+                "upstream": (
+                    self.upstream.stats()
+                    if self.upstream is not None else None
                 ),
                 "uptime_seconds": round(time.time() - self.started_at, 3),
             })
@@ -752,17 +818,20 @@ def _canonical(lower_name: str) -> str:
 
 
 class RouterHandle:
-    """A running front-door router: registry + prober + forwarder pool +
-    event-loop HTTP listener."""
+    """A running front-door router: registry + prober + loop-owned
+    upstream pool + event-loop HTTP listener."""
 
-    def __init__(self, registry, prober, forwarders, recorder,
+    def __init__(self, registry, prober, recorder,
                  httpd=None, capture=None) -> None:
         self.registry = registry
         self.prober = prober
-        self.forwarders = forwarders
         self.recorder = recorder
         self.httpd = httpd
+        self.upstream: UpstreamPool | None = None
         self.capture = capture  # learn.capture.CohortCapture or None
+        self.capture_feed: _CaptureFeed | None = (
+            _CaptureFeed(capture) if capture is not None else None
+        )
         self.deploy_status: dict | None = None
         self._deploy_lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -788,10 +857,9 @@ class RouterHandle:
     def shutdown(self) -> None:
         self.prober.close()
         self.httpd.shutdown()
-        self.httpd.server_close()
-        self.forwarders.close()
-        if self.capture is not None:
-            self.capture.close()
+        self.httpd.server_close()  # teardown closes the upstream pool too
+        if self.capture_feed is not None:
+            self.capture_feed.close()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
@@ -809,11 +877,12 @@ def make_router(
     fail_threshold: int = 2,
     recover_probes: int = 2,
     breaker_failures: int = 3,
-    forward_workers: int = 8,
     trace_capacity: int = 256,
     tail_quantile: float = 0.99,
     idle_timeout_s: float = 5.0,
     max_connections: int = 8192,
+    backlog: int = 1024,
+    reuse_port: bool = False,
     quiet: bool = True,
     start_prober: bool = True,
     capture_dir: str | None = None,
@@ -825,13 +894,14 @@ def make_router(
     ``replicas`` seeds the registry with static ``(id, url)`` members;
     dynamic members register themselves over ``POST /fleet/replicas``
     (``cli serve --register``). ``hedge_ms`` > 0 enables tail hedging;
-    ``max_attempts`` bounds retry fan-out per request. ``start_prober``
-    exists for tests that drive ``prober.tick()`` by hand.
-    ``capture_dir`` enables the continual-learning cohort tap
-    (``learn.capture``): every served /predict body lands in a bounded
-    rotating JSONL window there (~``capture_rows_per_shard`` ×
-    ``capture_max_shards`` recent rows) — the retrain's data source
-    (docs/CONTINUAL.md)."""
+    ``max_attempts`` bounds retry fan-out per request. ``reuse_port``
+    binds with ``SO_REUSEPORT`` for the multi-worker router
+    (``cli fleet router --workers N``). ``start_prober`` exists for
+    tests that drive ``prober.tick()`` by hand. ``capture_dir`` enables
+    the continual-learning cohort tap (``learn.capture``): every served
+    /predict body lands in a bounded rotating JSONL window there
+    (~``capture_rows_per_shard`` × ``capture_max_shards`` recent rows)
+    — the retrain's data source (docs/CONTINUAL.md)."""
     registry = ReplicaRegistry(
         fail_threshold=fail_threshold,
         recover_probes=recover_probes,
@@ -842,7 +912,6 @@ def make_router(
     prober = HealthProber(
         registry, interval_s=probe_interval_s, timeout_s=probe_timeout_s
     )
-    forwarders = _Forwarders(workers=forward_workers)
     recorder = reqtrace.FlightRecorder(
         capacity=trace_capacity, tail_quantile=tail_quantile
     )
@@ -857,23 +926,39 @@ def make_router(
             rows_per_shard=capture_rows_per_shard,
             max_shards=capture_max_shards,
         )
-    handle = RouterHandle(
-        registry, prober, forwarders, recorder, capture=capture
-    )
+    handle = RouterHandle(registry, prober, recorder, capture=capture)
     app = _RouterApp(
         handle, request_timeout_s,
         hedge_s=hedge_ms / 1000.0, max_attempts=max_attempts, quiet=quiet,
     )
+    # Backlog 1024, not the replica-side 128: a replica keeps its
+    # backlog small so bursts hit the batcher's explicit admission
+    # decision (the r6 lesson), but the router IS the front door — a
+    # thousand keep-alive clients connecting at once is its normal
+    # startup, its admission control is the deadline/shed machinery
+    # after accept, and a refused SYN costs the client a ~1 s
+    # retransmit stall that reads as router latency.
     try:
         handle.httpd = EventLoopHttpServer(
             (host, port), app,
             idle_timeout_s=idle_timeout_s,
             max_connections=max_connections,
+            backlog=backlog,
+            reuse_port=reuse_port,
         )
     except BaseException:
-        forwarders.close()
+        # A bind failure must not leak the already-started capture feed
+        # thread and its open shard — a supervisor retrying startup on
+        # a contended port would accumulate one orphan per attempt.
+        if handle.capture_feed is not None:
+            handle.capture_feed.close()
         raise
     app.httpd = handle.httpd
+    # The upstream leg lives on the same loop as the listener: one
+    # thread owns every socket end to end (module docstring).
+    handle.upstream = app.upstream = UpstreamPool(
+        handle.httpd, idle_timeout_s=idle_timeout_s,
+    )
     journal.event(
         "fleet_router_started",
         address=list(handle.httpd.server_address[:2]),

@@ -24,6 +24,15 @@ no sockets anywhere:
     response (status line, ``Content-Length`` always, ``Connection: close``
     when the connection will not be reused) as bytes for any transport to
     write.
+  * **The outbound leg.** The fleet router speaks HTTP in the other
+    direction too: ``build_request`` renders a request for an upstream
+    replica, and ``ResponseParser`` incrementally parses the reply the
+    same way ``RequestParser`` parses requests — fed raw fragments,
+    yielding one complete ``HttpResponse`` at a time, with the identical
+    framing discipline (``Content-Length`` required, caps enforced,
+    unframeable streams raise and the connection must close). A reused
+    upstream connection is only safe while both sides agree on byte
+    positions; the parser is where that agreement is checked.
 
 Every parse failure is a ``ProtocolError`` carrying the HTTP status to
 reply with and whatever request context (target, headers) was parsed before
@@ -53,6 +62,7 @@ REASONS = {
     431: "Request Header Fields Too Large",
     500: "Internal Server Error",
     501: "Not Implemented",
+    502: "Bad Gateway",
     503: "Service Unavailable",
     504: "Gateway Timeout",
 }
@@ -272,6 +282,165 @@ def _keep_alive(version: str, headers: dict[str, str]) -> bool:
     if version == "HTTP/1.0":
         return conn == "keep-alive"
     return conn != "close"
+
+
+class HttpResponse:
+    """One complete, framed upstream response: status code, headers,
+    body. ``headers`` keys are lower-cased; ``keep_alive`` is whether the
+    CONNECTION may carry another request after this reply (HTTP/1.1
+    defaults — the pooling decision also requires the parser to be empty,
+    which the transport checks)."""
+
+    __slots__ = ("code", "reason", "headers", "body", "keep_alive")
+
+    def __init__(
+        self,
+        code: int,
+        reason: str,
+        headers: dict[str, str],
+        body: bytes,
+        keep_alive: bool,
+    ) -> None:
+        self.code = code
+        self.reason = reason
+        self.headers = headers
+        self.body = body
+        self.keep_alive = keep_alive
+
+    def get_header(self, name: str, default: str | None = None) -> str | None:
+        return self.headers.get(name.lower(), default)
+
+
+class ResponseParser:
+    """Incremental HTTP/1.1 *response* parser — the outbound mirror of
+    ``RequestParser``, for the transport's upstream leg.
+
+    ``feed`` raw bytes as they arrive; ``next_response`` returns one
+    complete ``HttpResponse``, ``None`` while more bytes are needed, and
+    raises ``ProtocolError`` when the stream is garbled or exceeds a cap.
+    The framing rules are deliberately strict: every response this stack
+    emits carries a ``Content-Length`` (``build_response`` guarantees it),
+    so a missing/invalid one on the upstream leg means the peer is not one
+    of ours or the stream is desynced — unframeable either way, and the
+    connection must close. ``Transfer-Encoding`` is rejected for the same
+    reason as inbound. A ``ProtocolError`` here never reaches a client
+    as-is; the router classifies it as an upstream failure (retryable).
+
+    ``at_start`` distinguishes a clean EOF between responses (an idle
+    keep-alive connection the peer reaped — retryable on a fresh socket)
+    from an EOF mid-response (a truncated reply — the bytes received so
+    far are unusable and must never be taken for a complete answer).
+    """
+
+    def __init__(
+        self,
+        max_header_bytes: int = MAX_HEADER_BYTES,
+        max_body_bytes: int = MAX_BODY_BYTES,
+    ) -> None:
+        self.max_header_bytes = int(max_header_bytes)
+        self.max_body_bytes = int(max_body_bytes)
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> None:
+        self._buf += data
+
+    @property
+    def buffered(self) -> int:
+        return len(self._buf)
+
+    def at_start(self) -> bool:
+        """True when no response bytes are pending — the only state in
+        which a connection EOF is a clean close rather than truncation."""
+        return not self._buf
+
+    def next_response(self) -> HttpResponse | None:
+        buf = self._buf
+        if not buf:
+            return None
+        end = buf.find(b"\r\n\r\n")
+        if end < 0:
+            if len(buf) > self.max_header_bytes:
+                raise ProtocolError(
+                    502, f"upstream headers exceed {self.max_header_bytes} "
+                    "bytes"
+                )
+            return None
+        if end > self.max_header_bytes:
+            raise ProtocolError(
+                502, f"upstream headers exceed {self.max_header_bytes} bytes"
+            )
+        lines = bytes(buf[:end]).split(b"\r\n")
+        try:
+            parts = lines[0].decode("latin-1").split(None, 2)
+            version, code = parts[0], int(parts[1])
+            reason = parts[2] if len(parts) > 2 else ""
+        except (ValueError, IndexError):
+            raise ProtocolError(
+                502, "malformed upstream status line: "
+                f"{lines[0][:80].decode('latin-1')!r}"
+            )
+        if not version.startswith("HTTP/1."):
+            raise ProtocolError(
+                502, f"unsupported upstream protocol version {version}"
+            )
+        headers: dict[str, str] = {}
+        for raw in lines[1:]:
+            if not raw:
+                continue
+            name, sep, value = raw.partition(b":")
+            if not sep:
+                raise ProtocolError(
+                    502, "malformed upstream header line: "
+                    f"{raw[:80].decode('latin-1')!r}"
+                )
+            headers[name.decode("latin-1").strip().lower()] = \
+                value.decode("latin-1").strip()
+        if "transfer-encoding" in headers:
+            raise ProtocolError(
+                502, "upstream Transfer-Encoding is not supported"
+            )
+        try:
+            length = int(headers.get("content-length"))  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            length = -1
+        if length < 0:
+            # Every server in this stack stamps Content-Length on every
+            # reply; its absence means close-delimited framing (not part
+            # of this contract) or a desynced stream.
+            raise ProtocolError(
+                502, "upstream response missing or invalid Content-Length"
+            )
+        if length > self.max_body_bytes:
+            raise ProtocolError(
+                502, f"upstream body exceeds {self.max_body_bytes} bytes"
+            )
+        body_start = end + 4
+        if len(buf) - body_start < length:
+            return None  # body still in flight
+        body = bytes(buf[body_start:body_start + length])
+        del buf[:body_start + length]
+        keep_alive = _keep_alive(version, headers)
+        return HttpResponse(code, reason, headers, body, keep_alive)
+
+
+def build_request(
+    method: str,
+    target: str,
+    headers: dict[str, str] | None = None,
+    body: bytes = b"",
+    host: str = "",
+) -> bytes:
+    """Render a complete HTTP/1.1 request as bytes — the outbound leg's
+    counterpart of ``build_response``. ``Content-Length`` is always
+    present on body-carrying methods (the framing contract both parsers
+    enforce); connections default to keep-alive."""
+    lines = [f"{method} {target} HTTP/1.1", f"Host: {host or 'localhost'}"]
+    if method in _BODY_METHODS or body:
+        lines.append(f"Content-Length: {len(body)}")
+    if headers:
+        lines.extend(f"{k}: {v}" for k, v in headers.items())
+    head = "\r\n".join(lines).encode("latin-1") + b"\r\n\r\n"
+    return head + body
 
 
 def build_response(

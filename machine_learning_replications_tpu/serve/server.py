@@ -896,6 +896,10 @@ class _App:
                     # it rotates on: one GET per replica per tick.
                     "replica": handle.replica_id,
                     "version": handle.model_version,
+                    # ... and the admission-queue depth: the router's
+                    # least-loaded score and the autoscaler both read
+                    # replica load without an extra request.
+                    "queue_depth": self.batcher.queue_depth,
                 },
             )
         elif path == "/admin/deploy":

@@ -247,13 +247,18 @@ def test_registry_replacement_accounts_rotation_out():
     assert FLEET_ROTATIONS.labels(direction="out").value == out0 + 1
 
 
-def test_registry_pick_round_robin_and_exclude():
+def test_registry_pick_spreads_cold_fleet_and_exclude():
+    # With no load signal yet, power-of-two-choices ties break to the
+    # least recently picked of each sampled pair, so a cold fleet still
+    # spreads traffic across every replica.
     reg = ReplicaRegistry()
     for rid in ("a", "b", "c"):
         reg.register(rid, f"http://{rid}:1")
         reg.observe_probe(rid, ok=True, ready=True)
-    picks = [reg.pick()["id"] for _ in range(6)]
+    picks = [reg.pick()["id"] for _ in range(64)]
     assert sorted(set(picks)) == ["a", "b", "c"]
+    counts = {rid: picks.count(rid) for rid in ("a", "b", "c")}
+    assert all(n >= 8 for n in counts.values()), counts
     # exclude prefers untried replicas...
     assert reg.pick(exclude={"a", "b"})["id"] == "c"
     # ...but falls back to a tried one rather than failing the request.
@@ -319,7 +324,13 @@ def test_load_model_versioned_reports_rollback(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_router_round_robin_and_identity_passthrough():
+def test_router_least_loaded_rotation_and_identity_passthrough():
+    # Least-loaded picking must still EXPLORE: an unsampled replica is
+    # preferred until it has a latency measurement, so both replicas see
+    # traffic even from a strictly sequential client (a concentration on
+    # the faster replica afterwards is the new contract, not a bug —
+    # the load-spreading behavior under concurrency is asserted in
+    # test_registry_least_loaded_*).
     router, stubs, httpds, base = _stub_fleet(2)
     try:
         stubs[1].version = 2
@@ -331,7 +342,7 @@ def test_router_round_robin_and_identity_passthrough():
             assert headers["X-Serve-Path"] == "host"
             assert "X-Request-Id" in headers
         assert seen == {("r1", "1"), ("r2", "2")}
-        assert stubs[0].served >= 3 and stubs[1].served >= 3
+        assert stubs[0].served >= 1 and stubs[1].served >= 1
         # The remaining deadline rode down to the replicas.
         raw = [h for s in stubs for h in s.deadline_headers if h]
         assert raw and all(0 < float(h) <= 5000 for h in raw)
@@ -615,7 +626,8 @@ def test_probe_replica_verdicts():
     httpd, url = _start_stub(stub)
     try:
         v = probe_replica(url)
-        assert v == {"ok": True, "ready": True, "version": 7}
+        assert v == {"ok": True, "ready": True, "version": 7,
+                     "queue_depth": None}
         stub.ready = False
         v = probe_replica(url)
         assert v["ok"] and not v["ready"]

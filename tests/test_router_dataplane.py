@@ -1,0 +1,701 @@
+"""Router data plane (ISSUE 12): the transport's outbound leg and the
+registry's least-loaded rotation.
+
+Three layers, mirroring the serving stack's own test split:
+
+  * ``protocol.ResponseParser`` / ``build_request`` — pure byte-level
+    rules, no sockets.
+  * ``transport.UpstreamPool`` — the loop-owned upstream machinery
+    against scripted raw-socket upstreams: keep-alive reuse, premature
+    close mid-headers, half-close mid-body, a truncated/over-long reply
+    poisoning a pooled connection (must close, never desync the next
+    attempt), write backpressure against a slow reader, the transparent
+    stale-connection resend, and attempt timeouts.
+  * ``fleet`` — least-loaded power-of-two-choices picking on live load
+    signals, and connection reuse counted across retries and hedges
+    through the real router.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from machine_learning_replications_tpu.fleet.registry import ReplicaRegistry
+from machine_learning_replications_tpu.serve import protocol
+from machine_learning_replications_tpu.serve.transport import (
+    EventLoopHttpServer,
+    UpstreamError,
+    UpstreamPool,
+    UpstreamTimeout,
+)
+
+
+# ---------------------------------------------------------------------------
+# protocol: the response parser and request builder (pure)
+# ---------------------------------------------------------------------------
+
+
+def _resp_bytes(code=200, body=b'{"p": 1}', extra="", keep_alive=True,
+                content_length=None):
+    cl = len(body) if content_length is None else content_length
+    head = (
+        f"HTTP/1.1 {code} X\r\nContent-Type: application/json\r\n"
+        f"Content-Length: {cl}\r\n{extra}"
+    )
+    if not keep_alive:
+        head += "Connection: close\r\n"
+    return head.encode() + b"\r\n" + body
+
+
+def test_response_parser_single_and_split_reads():
+    p = protocol.ResponseParser()
+    raw = _resp_bytes(body=b"hello")
+    for cut in range(1, len(raw)):
+        p = protocol.ResponseParser()
+        p.feed(raw[:cut])
+        first = p.next_response()
+        p.feed(raw[cut:])
+        resp = first or p.next_response()
+        assert resp is not None
+        assert resp.code == 200 and resp.body == b"hello"
+        assert resp.keep_alive
+        assert p.at_start()
+
+
+def test_response_parser_connection_close_and_http10():
+    p = protocol.ResponseParser()
+    p.feed(_resp_bytes(keep_alive=False))
+    assert not p.next_response().keep_alive
+    p = protocol.ResponseParser()
+    p.feed(b"HTTP/1.0 200 OK\r\nContent-Length: 0\r\n\r\n")
+    assert not p.next_response().keep_alive  # 1.0 defaults to close
+
+
+def test_response_parser_missing_content_length_is_unframeable():
+    p = protocol.ResponseParser()
+    p.feed(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n\r\nbody")
+    with pytest.raises(protocol.ProtocolError):
+        p.next_response()
+
+
+def test_response_parser_garbled_status_line():
+    p = protocol.ResponseParser()
+    p.feed(b"not http at all\r\n\r\n")
+    with pytest.raises(protocol.ProtocolError):
+        p.next_response()
+
+
+def test_response_parser_leftover_bytes_visible_via_at_start():
+    # An over-long reply (bytes past the declared Content-Length) parses
+    # as a complete response PLUS leftover bytes — at_start() is how the
+    # transport detects the poisoned framing and refuses to pool.
+    p = protocol.ResponseParser()
+    p.feed(_resp_bytes(body=b"okGARBAGE", content_length=2))
+    resp = p.next_response()
+    assert resp.code == 200 and resp.body == b"ok"
+    assert not p.at_start()
+
+
+def test_build_request_framing_roundtrip():
+    data = protocol.build_request(
+        "POST", "/predict", {"X-Request-Id": "r1"}, b'{"x": 1}',
+        host="rep-1",
+    )
+    rp = protocol.RequestParser()
+    rp.feed(data)
+    req = rp.next_request()
+    assert req.method == "POST" and req.path == "/predict"
+    assert req.body == b'{"x": 1}'
+    assert req.get_header("x-request-id") == "r1"
+    assert req.get_header("host") == "rep-1"
+    assert req.keep_alive
+
+
+# ---------------------------------------------------------------------------
+# transport: the loop-owned upstream pool against scripted raw upstreams
+# ---------------------------------------------------------------------------
+
+
+class _NullApp:
+    def handle_request(self, req, rsp):
+        rsp.send_json(404, {})
+
+    def handle_protocol_error(self, exc, rsp):
+        rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+
+class _PoolHarness:
+    """An event loop + UpstreamPool driven synchronously from the test
+    thread: ``call`` posts one attempt onto the loop and waits for its
+    completion."""
+
+    def __init__(self, **pool_kw):
+        self.server = EventLoopHttpServer(("127.0.0.1", 0), _NullApp())
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+        self.pool = UpstreamPool(self.server, **pool_kw)
+
+    def call(self, addr, key="r", body=b'{"x": 1}', timeout_s=5.0,
+             wait_s=10.0):
+        data = protocol.build_request(
+            "POST", "/predict", {"Content-Type": "application/json"}, body
+        )
+        done = threading.Event()
+        out = []
+
+        def go():
+            self.pool.request(
+                key, addr, data, timeout_s,
+                lambda res: (out.append(res), done.set()),
+            )
+
+        self.server._post(go)
+        assert done.wait(wait_s), "upstream attempt never completed"
+        return out[0]
+
+    def close(self):
+        self.server.server_close()
+
+
+def _read_request(sock) -> bytes:
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return buf
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":")[1])
+    while len(rest) < length:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        rest += chunk
+    return head + b"\r\n\r\n" + rest
+
+
+class _ScriptedUpstream:
+    """A raw-socket upstream whose Nth accepted connection runs the Nth
+    script (the last script repeats). Each script gets the accepted
+    socket and drives the exchange however the scenario needs."""
+
+    def __init__(self, scripts, rcvbuf=None):
+        self.scripts = scripts
+        self.accepted = 0
+        self.lock = threading.Lock()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        if rcvbuf:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.addr = self.sock.getsockname()
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with self.lock:
+                i = min(self.accepted, len(self.scripts) - 1)
+                self.accepted += 1
+            threading.Thread(
+                target=self._run, args=(conn, self.scripts[i]), daemon=True
+            ).start()
+
+    def _run(self, conn, script):
+        try:
+            script(conn)
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _serve_ok(conn, n=1000):
+    """Well-behaved keep-alive upstream: parse requests, answer each."""
+    for _ in range(n):
+        req = _read_request(conn)
+        if not req or b"\r\n\r\n" not in req:
+            return
+        conn.sendall(_resp_bytes(body=b'{"ok": true}'))
+
+
+def test_upstream_keepalive_reuse_and_stats():
+    up = _ScriptedUpstream([_serve_ok])
+    h = _PoolHarness()
+    try:
+        for _ in range(5):
+            resp = h.call(up.addr)
+            assert not isinstance(resp, Exception)
+            assert resp.code == 200 and resp.body == b'{"ok": true}'
+        stats = h.pool.stats()
+        assert stats["opened_total"] == 1 and stats["reused_total"] == 4
+        assert up.accepted == 1
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_premature_close_mid_headers():
+    def mid_headers(conn):
+        _read_request(conn)
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Le")
+        # close (script returns)
+
+    up = _ScriptedUpstream([mid_headers])
+    h = _PoolHarness()
+    try:
+        res = h.call(up.addr)
+        assert isinstance(res, UpstreamError)
+        assert "truncated" in str(res)
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_half_close_mid_body():
+    def mid_body(conn):
+        _read_request(conn)
+        conn.sendall(_resp_bytes(body=b"short", content_length=100))
+
+    up = _ScriptedUpstream([mid_body])
+    h = _PoolHarness()
+    try:
+        res = h.call(up.addr)
+        assert isinstance(res, UpstreamError)
+        assert "truncated" in str(res)
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_overlong_reply_poisons_connection_not_next_attempt():
+    # Connection 1 replies with bytes PAST its declared Content-Length:
+    # the response itself is served, but the connection must close — a
+    # reuse would hand the garbage to the next attempt as its status
+    # line. Connection 2 serves correctly; the pool must have opened it
+    # fresh rather than desyncing.
+    def overlong(conn):
+        _read_request(conn)
+        conn.sendall(_resp_bytes(body=b'{"a": 1}GARBAGE',
+                                 content_length=len(b'{"a": 1}')))
+        time.sleep(0.5)  # stay open: a naive pool would reuse us
+
+    up = _ScriptedUpstream([overlong, _serve_ok])
+    h = _PoolHarness()
+    try:
+        r1 = h.call(up.addr)
+        assert not isinstance(r1, Exception)
+        assert r1.code == 200 and r1.body == b'{"a": 1}'
+        r2 = h.call(up.addr)
+        assert not isinstance(r2, Exception)
+        assert r2.code == 200 and r2.body == b'{"ok": true}'
+        assert up.accepted == 2, "poisoned connection was reused"
+        assert h.pool.stats()["reused_total"] == 0
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_write_backpressure_slow_reader():
+    # A replica that drains its socket slowly: with the send buffers
+    # shrunk below the request size, the request CANNOT be written in
+    # one send — the loop must ride partial writes + write-interest
+    # until the reader catches up, then still parse the reply.
+    body = b"x" * 48 * 1024
+
+    def slow_reader(conn):
+        time.sleep(0.3)  # let the client's buffers fill first
+        req = _read_request(conn)
+        assert req.endswith(body)
+        conn.sendall(_resp_bytes(body=b'{"got": "all"}'))
+
+    up = _ScriptedUpstream([slow_reader], rcvbuf=4096)
+    h = _PoolHarness(configure_sock=lambda s: s.setsockopt(
+        socket.SOL_SOCKET, socket.SO_SNDBUF, 8192
+    ))
+    try:
+        res = h.call(up.addr, body=body)
+        assert not isinstance(res, Exception), res
+        assert res.code == 200 and res.body == b'{"got": "all"}'
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_reset_mid_reply_fails_instead_of_resending():
+    # An RST after reply bytes have arrived is a TRUNCATED reply, not
+    # the stale-keep-alive race: a transparent resend here would
+    # silently execute the request twice after the replica already
+    # started answering it. The send path and the EOF path must agree.
+    import struct
+
+    served = []
+
+    def rst_mid_body(conn):
+        served.append(1)
+        _read_request(conn)
+        conn.sendall(_resp_bytes(body=b"0123456789", content_length=100))
+        time.sleep(0.1)
+        # SO_LINGER 0 + close → RST, not FIN.
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+
+    up = _ScriptedUpstream([rst_mid_body])
+    h = _PoolHarness()
+    try:
+        res = h.call(up.addr)
+        assert isinstance(res, UpstreamError), res
+        assert "truncated" in str(res)
+        time.sleep(0.2)
+        assert len(served) == 1, "request was re-executed after a mid-reply reset"
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_stale_pooled_connection_transparent_resend():
+    # The keep-alive race every proxy has: the pooled connection dies
+    # between requests (idle reap, replica restart). The pool resends
+    # ONCE on a fresh connection — the attempt succeeds, the failure
+    # never surfaces to the retry policy.
+    def serve_one_then_die(conn):
+        _read_request(conn)
+        conn.sendall(_resp_bytes(body=b'{"n": 1}'))
+        # close immediately after the reply WITHOUT Connection: close —
+        # the client pools it, then finds it dead.
+
+    up = _ScriptedUpstream([serve_one_then_die, _serve_ok])
+    h = _PoolHarness()
+    try:
+        r1 = h.call(up.addr)
+        assert r1.code == 200
+        time.sleep(0.1)  # let the server's FIN land
+        r2 = h.call(up.addr)
+        assert not isinstance(r2, Exception), r2
+        assert r2.code == 200 and r2.body == b'{"ok": true}'
+        assert up.accepted == 2
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_attempt_timeout_is_bounded():
+    def black_hole(conn):
+        _read_request(conn)
+        time.sleep(5.0)
+
+    up = _ScriptedUpstream([black_hole])
+    h = _PoolHarness()
+    try:
+        t0 = time.monotonic()
+        res = h.call(up.addr, timeout_s=0.4)
+        assert isinstance(res, UpstreamTimeout)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        h.close()
+        up.close()
+
+
+def test_upstream_idle_connections_reaped():
+    up = _ScriptedUpstream([_serve_ok])
+    h = _PoolHarness(idle_timeout_s=0.3)
+    try:
+        assert h.call(up.addr).code == 200
+        assert h.pool.stats()["idle"] == 1
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and h.pool.stats()["idle"]:
+            time.sleep(0.05)
+        assert h.pool.stats()["idle"] == 0
+        assert h.pool.stats()["connections"] == 0
+    finally:
+        h.close()
+        up.close()
+
+
+# ---------------------------------------------------------------------------
+# registry: least-loaded power-of-two-choices
+# ---------------------------------------------------------------------------
+
+
+def _ready_registry(*rids, **kw):
+    reg = ReplicaRegistry(**kw)
+    for rid in rids:
+        reg.register(rid, f"http://{rid}:1")
+        reg.observe_probe(rid, ok=True, ready=True)
+    return reg
+
+
+def test_registry_least_loaded_prefers_fewer_outstanding():
+    reg = _ready_registry("a", "b")
+    # Equal latency on both; a carries in-flight attempts.
+    reg.note_complete("a", 0.010)
+    reg.note_dispatch("a")  # net: 1 outstanding after the complete
+    reg.note_dispatch("a")
+    reg.note_complete("b", 0.010)
+    for _ in range(8):
+        assert reg.pick()["id"] == "b"
+
+
+def test_registry_least_loaded_prefers_lower_ewma_latency():
+    reg = _ready_registry("a", "b")
+    for _ in range(4):
+        reg.note_dispatch("a")
+        reg.note_complete("a", 0.200)  # slow replica
+        reg.note_dispatch("b")
+        reg.note_complete("b", 0.002)  # fast replica
+    picks = [reg.pick()["id"] for _ in range(10)]
+    assert picks.count("b") == 10
+
+
+def test_registry_queue_depth_probe_signal_folds_into_score():
+    reg = _ready_registry("a", "b")
+    reg.note_complete("a", 0.010)
+    reg.note_complete("b", 0.010)
+    # Same observed latency, but a's OWN probe reports a deep queue
+    # (e.g. load from another router worker this registry never saw).
+    reg.observe_probe("a", ok=True, ready=True, queue_depth=20)
+    reg.observe_probe("b", ok=True, ready=True, queue_depth=0)
+    for _ in range(8):
+        assert reg.pick()["id"] == "b"
+
+
+def test_registry_ewma_update_and_outstanding_floor():
+    reg = _ready_registry("a")
+    reg.note_dispatch("a")
+    reg.note_complete("a", 0.100)
+    load = reg.get("a")["load"]
+    assert load["ewma_latency_ms"] == pytest.approx(100.0)
+    assert load["outstanding"] == 0
+    reg.note_complete("a", 0.200)  # EWMA alpha=0.2: 100 + 0.2*100
+    load = reg.get("a")["load"]
+    assert load["ewma_latency_ms"] == pytest.approx(120.0)
+    assert load["outstanding"] == 0  # never below zero
+    # Conn-error completions release the slot without poisoning the EWMA.
+    reg.note_dispatch("a")
+    reg.note_complete("a", None)
+    load = reg.get("a")["load"]
+    assert load["ewma_latency_ms"] == pytest.approx(120.0)
+    assert load["outstanding"] == 0
+
+
+def test_registry_snapshot_carries_load_block():
+    reg = _ready_registry("a")
+    reg.note_dispatch("a")
+    snap = reg.snapshot()[0]
+    assert snap["load"]["outstanding"] == 1
+    assert snap["load"]["ewma_latency_ms"] is None
+    assert snap["load"]["last_queue_depth"] is None
+    assert snap["load"]["score"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# the router end to end: reuse across retries/hedges, load-aware picking
+# ---------------------------------------------------------------------------
+
+
+from test_fleet import _StubReplica, _start_stub, _stub_fleet, _teardown, \
+    _post_predict  # noqa: E402
+from machine_learning_replications_tpu.fleet.router import (  # noqa: E402
+    FLEET_UPSTREAM_CONNS,
+)
+
+
+def test_router_connection_reuse_across_retries():
+    # r1's breaker opens on its first 500; every subsequent request
+    # lands on r2 over ONE pooled connection — reuse accounting must
+    # show the retried request and its successors riding it.
+    router, stubs, httpds, base = _stub_fleet(2, breaker_failures=1)
+    reused0 = FLEET_UPSTREAM_CONNS.labels(event="reused").value
+    try:
+        stubs[0].mode = "error"
+        for _ in range(6):
+            code, headers, _ = _post_predict(base)
+            assert code == 200 and headers["X-Replica"] == "r2"
+        assert FLEET_UPSTREAM_CONNS.labels(event="reused").value \
+            >= reused0 + 4
+        stats = router.upstream.stats()
+        assert stats["reused_total"] >= 4, stats
+    finally:
+        _teardown(router, httpds)
+
+
+def test_router_connection_reuse_across_hedges():
+    # The hedge's winning attempt opens (or reuses) the same pooled
+    # connection later direct requests ride: the pool is shared across
+    # ordinary attempts, retries, and hedges alike.
+    router, stubs, httpds, base = _stub_fleet(
+        2, hedge_ms=100.0, request_timeout_s=8.0, fail_threshold=50,
+    )
+    try:
+        stubs[0].mode = "stall"
+        stubs[0].stall_s = 1.5
+        for _ in range(4):
+            code, _, _ = _post_predict(base)
+            assert code == 200
+        stats = router.upstream.stats()
+        # 4 ok replies but far fewer fresh connections than attempts:
+        # the hedge target's connection was pooled and reused.
+        assert stats["reused_total"] >= 2, stats
+    finally:
+        _teardown(router, httpds)
+
+
+def test_router_load_signals_on_control_plane():
+    router, stubs, httpds, base = _stub_fleet(2)
+    try:
+        for _ in range(6):
+            assert _post_predict(base)[0] == 200
+        import urllib.request
+
+        with urllib.request.urlopen(
+            base + "/fleet/replicas", timeout=5
+        ) as resp:
+            replicas = json.loads(resp.read())["replicas"]
+        served = [r for r in replicas if r["load"]["ewma_latency_ms"]]
+        assert served, replicas
+        for r in replicas:
+            assert r["load"]["outstanding"] == 0  # all settled
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as resp:
+            health = json.loads(resp.read())
+        assert health["upstream"]["opened_total"] >= 1
+    finally:
+        _teardown(router, httpds)
+
+
+def test_cancelled_hedge_loser_releases_outstanding():
+    # The losing attempt of a won hedge is CANCELLED (its completion
+    # never fires): its replica's outstanding count must be released by
+    # the settle path, or every lost hedge leaks +1 forever and the
+    # least-loaded score starves the replica monotonically.
+    router, stubs, httpds, base = _stub_fleet(
+        2, hedge_ms=100.0, request_timeout_s=8.0, fail_threshold=50,
+    )
+    try:
+        stubs[0].mode = "stall"
+        stubs[0].stall_s = 2.0
+        for _ in range(3):
+            code, _, _ = _post_predict(base)
+            assert code == 200
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline:
+            loads = {
+                r["id"]: r["load"]["outstanding"]
+                for r in router.registry.snapshot()
+            }
+            if all(v == 0 for v in loads.values()):
+                break
+            time.sleep(0.1)
+        assert all(v == 0 for v in loads.values()), loads
+    finally:
+        _teardown(router, httpds)
+
+
+def test_probe_queue_depth_garbage_does_not_poison_registry():
+    # /readyz bodies come from anything that registered itself: a
+    # non-numeric queue_depth must be ignored, not raise out of the
+    # probe pass (which would freeze probing for every replica behind
+    # the bad one).
+    reg = _ready_registry("a")
+    reg.observe_probe("a", ok=True, ready=True, queue_depth="n/a")
+    assert reg.get("a")["load"]["last_queue_depth"] is None
+    reg.observe_probe("a", ok=True, ready=True, queue_depth=3)
+    assert reg.get("a")["load"]["last_queue_depth"] == 3
+    reg.observe_probe("a", ok=True, ready=True, queue_depth=[1])
+    assert reg.get("a")["load"]["last_queue_depth"] == 3  # kept, not lost
+
+
+def test_loadgen_baseline_url_overhead_join(tmp_path):
+    # One loadgen run, interleaved through-router and direct-replica
+    # slices: the artifact carries both sides and the router-added
+    # latency deltas as first-class fields.
+    import os
+    import subprocess
+    import sys
+
+    router, stubs, httpds, base = _stub_fleet(1)
+    direct = f"http://127.0.0.1:{httpds[0].server_address[1]}"
+    out_path = tmp_path / "bl.json"
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "loadgen.py"),
+             "--url", base, "--baseline-url", direct,
+             "--connections", "4", "--duration", "2",
+             "--baseline-segments", "2", "--out", str(out_path)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert res.returncode == 0, res.stderr
+        art = json.loads(out_path.read_text())
+        assert art["n_ok"] > 0 and art["n_err"] == 0
+        assert art["baseline"]["url"] == direct
+        assert art["baseline"]["n_ok"] > 0
+        assert art["baseline"]["n_err"] == 0
+        ovh = art["router_overhead_ms"]
+        assert ovh["segments_per_target"] == 2
+        # A stub replica answers in microseconds; the router hop is real
+        # but small — the field just has to be a number, both sides
+        # having served.
+        assert isinstance(ovh["p50"], float)
+        assert isinstance(ovh["p99"], float)
+    finally:
+        _teardown(router, httpds)
+
+
+def test_loadgen_baseline_url_rejects_perturb_and_open_mode():
+    import os
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "loadgen.py")
+    for extra in (["--perturb", "Age+1"], ["--mode", "open"]):
+        res = subprocess.run(
+            [sys.executable, tool, "--url", "http://127.0.0.1:1",
+             "--baseline-url", "http://127.0.0.1:2",
+             "--duration", "1"] + extra,
+            capture_output=True, text=True, timeout=60,
+        )
+        assert res.returncode != 0
+        assert "--baseline-url" in res.stderr
+
+
+def test_router_prefers_fast_replica_under_sequential_load():
+    # One replica 60 ms slower than the other: once both have a sample,
+    # least-loaded picking concentrates sequential traffic on the fast
+    # one (round-robin would split 50/50 and pay the slow tax on half).
+    router, stubs, httpds, base = _stub_fleet(
+        2, hedge_ms=0.0, request_timeout_s=8.0,
+    )
+    try:
+        stubs[0].mode = "stall"
+        stubs[0].stall_s = 0.06
+        for _ in range(12):
+            assert _post_predict(base)[0] == 200
+        assert stubs[1].served > stubs[0].served, (
+            stubs[0].served, stubs[1].served,
+        )
+    finally:
+        _teardown(router, httpds)

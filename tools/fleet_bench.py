@@ -1,0 +1,414 @@
+#!/usr/bin/env python
+"""Router bench driver — the first 1000-connection bench of the fleet's
+front door; writes a FLEET_BENCH_*.json artifact.
+
+The serving ceiling has been measured replica-side since r6 and the
+router had never been pointed at by ``loadgen --connections 1000``
+(ROADMAP item 1). This driver stands the whole service up and measures
+it as one unit:
+
+  1. publishes a checkpoint (sklearn-imported ensemble, the chaos
+     drill's model) — or serves ``--model`` if given;
+  2. starts the front-door router in-process (journal + metrics owned
+     here) and N real ``cli serve`` replica subprocesses that
+     self-register and probe into rotation;
+  3. runs ONE ``tools/loadgen.py`` subprocess against the router with
+     ``--baseline-url`` pointed at replica 1 — the run interleaves
+     through-router and direct-replica slices, so the artifact carries
+     throughput AND the router-added overhead deltas
+     (``router_overhead_ms``) from the same minutes on the same host;
+  4. augments the artifact with the fleet's own view: registry snapshot
+     (per-replica load signals the balancer picked on), upstream pool
+     connection stats, router config;
+  5. strict-validates the router's ``/metrics`` page
+     (``--metrics-out``) and enforces the invariants:
+     **zero client errors**, ``--assert-qps`` (achieved through-router
+     qps floor), and ``--assert-overhead-ms`` (router-added p50
+     ceiling) — the CI ``router-bench`` job runs a compressed pass on
+     every push.
+
+Run from the repo root (CPU is fine)::
+
+    JAX_PLATFORMS=cpu python tools/fleet_bench.py \\
+        --connections 1000 --duration 60 --out FLEET_BENCH_r17_cpu.json \\
+        --metrics-out fleet_bench_metrics.txt --journal fleet_bench.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from chaos_drill import make_sklearn_params, _free_port, wait_until  # noqa: E402
+
+
+def _spawn_replica(rid: str, port: int, ckpt: str, register_url: str,
+                   serve_args: list[str], quiet: bool):
+    sink = subprocess.DEVNULL if quiet else None
+    return subprocess.Popen(
+        [sys.executable, "-m", "machine_learning_replications_tpu",
+         "serve", "--model", ckpt, "--port", str(port),
+         "--replica-id", rid, "--register", register_url] + serve_args,
+        stdout=sink, stderr=sink,
+    )
+
+
+def _run_stub_worker(port: int) -> int:
+    """``--_stub-worker``: a minimal constant-reply replica on the real
+    event-loop transport, in its own process. The ``--stub-replicas``
+    mode measures the ROUTER's data plane against these — replica
+    compute off the table, every byte of proxy machinery on it."""
+    import threading
+
+    from machine_learning_replications_tpu.serve.transport import (
+        EventLoopHttpServer,
+    )
+
+    body_headers = {"X-Replica": f"stub{port}", "X-Model-Version": "1",
+                    "X-Serve-Path": "host"}
+
+    class _StubApp:
+        def handle_request(self, req, rsp):
+            if req.path == "/readyz":
+                rsp.send_json(200, {"ready": True, "version": 1,
+                                    "queue_depth": 0})
+                return
+            rsp.send_json(200, {"probability": 0.25},
+                          headers=body_headers,
+                          request_id=req.get_header("x-request-id"))
+
+        def handle_protocol_error(self, exc, rsp):
+            rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+    # Backlog sized for the router pool's connect bursts: a stub is a
+    # data-plane measurement device, not an admission-control study.
+    httpd = EventLoopHttpServer(("127.0.0.1", port), _StubApp(),
+                                backlog=1024)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    done.wait()
+    httpd.server_close()
+    return 0
+
+
+def _spawn_stub(port: int):
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--_stub-worker", str(port)],
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--connections", type=int, default=1000)
+    ap.add_argument("--duration", type=float, default=60.0,
+                    help="total loadgen seconds (split across the "
+                    "interleaved router/baseline slices)")
+    ap.add_argument("--rate-per-conn", type=float, default=0.0,
+                    help="pace each connection (0 = saturation)")
+    ap.add_argument("--baseline-segments", type=int, default=3)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the interleaved direct-replica leg: one "
+                    "continuous through-router run. The saturation "
+                    "ceiling cell uses this — at saturation a slice "
+                    "boundary strands ~connections in-flight requests "
+                    "that pollute the next slice, so overhead is "
+                    "measured by a separate paced --baseline-url run")
+    ap.add_argument("--model", default=None,
+                    help="serve an existing checkpoint instead of "
+                    "publishing the synthetic bench model")
+    ap.add_argument("--stub-replicas", action="store_true",
+                    help="replicas are minimal constant-reply stub "
+                    "processes on the real transport: the router-data-"
+                    "plane ceiling cell — on a small host the full "
+                    "stack saturates total CPU long before the router "
+                    "does (BENCH.md stage math)")
+    ap.add_argument("--_stub-worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--serve-arg", action="append", default=None,
+                    help="extra cli serve argument (repeatable; "
+                    "--serve-arg=--no-quality form for dash-leading)")
+    ap.add_argument("--hedge-ms", type=float, default=0.0,
+                    help="router hedge delay (0 disables; saturation "
+                    "benches must not hedge a fully loaded fleet)")
+    ap.add_argument("--router-workers", type=int, default=0,
+                    help="run the router as `cli fleet router --workers "
+                    "N` SO_REUSEPORT processes instead of in-process — "
+                    "the many-core scaling cell (0 = in-process router)")
+    ap.add_argument("--request-timeout", type=float, default=30.0)
+    ap.add_argument("--warm-s", type=float, default=3.0,
+                    help="pre-bench warm traffic seconds (compile/route "
+                    "warmup stays out of the measured window)")
+    ap.add_argument("--out", default=None, help="artifact path (JSON)")
+    ap.add_argument("--metrics-out", default=None,
+                    help="write the router's /metrics page here and "
+                    "strict-validate it")
+    ap.add_argument("--journal", default=None,
+                    help="router journal path (obs_report --fleet joins "
+                    "it with the artifact)")
+    ap.add_argument("--assert-qps", type=float, default=None,
+                    help="fail unless through-router achieved qps >= this")
+    ap.add_argument("--assert-overhead-ms", type=float, default=None,
+                    help="fail unless router-added p50 <= this")
+    ap.add_argument("--ready-timeout", type=float, default=300.0)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if getattr(args, "_stub_worker", None):
+        return _run_stub_worker(args._stub_worker)
+
+    from machine_learning_replications_tpu.fleet import make_router
+    from machine_learning_replications_tpu.obs import journal
+    from machine_learning_replications_tpu.obs.registry import REGISTRY
+
+    workdir = tempfile.mkdtemp(prefix="fleet_bench_")
+    jrn = None
+    if args.journal:
+        jrn = journal.RunJournal(args.journal, command="fleet_bench")
+        journal.set_journal(jrn)
+
+    ckpt = args.model
+    if ckpt is None and not args.stub_replicas:
+        from machine_learning_replications_tpu.persist import orbax_io
+
+        ckpt = os.path.join(workdir, "model")
+        orbax_io.save_model(ckpt, make_sklearn_params(seed=7))
+        print(f"published bench checkpoint at {ckpt}", file=sys.stderr)
+
+    serve_args = list(args.serve_arg or [])
+    procs = {}
+    router = None          # in-process RouterHandle
+    router_proc = None     # `cli fleet router --workers N` subprocess
+    rc = 1
+
+    # Stub replicas are spawned before a multi-worker router so their
+    # urls can seed EVERY worker's registry statically (stubs do not
+    # self-register); real replicas self-register, so they come after
+    # the router regardless of its mode.
+    stub_members = []
+    if args.stub_replicas:
+        for i in range(args.replicas):
+            rid = f"b{i + 1}"
+            port = _free_port()
+            procs[rid] = _spawn_stub(port)
+            stub_members.append((rid, f"http://127.0.0.1:{port}"))
+
+    if args.router_workers:
+        rport = _free_port()
+        base = f"http://127.0.0.1:{rport}"
+        rcmd = [sys.executable, "-m", "machine_learning_replications_tpu",
+                "fleet", "router", "--port", str(rport),
+                "--workers", str(args.router_workers),
+                "--hedge-ms", str(args.hedge_ms),
+                "--request-timeout", str(args.request_timeout)]
+        for rid, url in stub_members:
+            rcmd += ["--replica", f"{rid}={url}"]
+        sink = None if args.verbose else subprocess.DEVNULL
+        router_proc = subprocess.Popen(rcmd, stdout=sink, stderr=sink)
+    else:
+        router = make_router(
+            port=0, probe_interval_s=0.5,
+            request_timeout_s=args.request_timeout,
+            hedge_ms=args.hedge_ms, max_attempts=3,
+        ).start_background()
+        base = f"http://{router.address[0]}:{router.address[1]}"
+        for rid, url in stub_members:
+            router.registry.register(rid, url)
+
+    def http_json(path):
+        import urllib.request
+
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def ready_count():
+        if router is not None:
+            return router.registry.ready_count()
+        try:
+            return sum(
+                1 for r in http_json("/fleet/replicas")["replicas"]
+                if r["in_rotation"]
+            )
+        except Exception:
+            return 0
+
+    def registry_snapshot():
+        if router is not None:
+            return router.registry.snapshot()
+        return http_json("/fleet/replicas")["replicas"]
+
+    try:
+        if not args.stub_replicas:
+            for i in range(args.replicas):
+                procs[f"b{i + 1}"] = _spawn_replica(
+                    f"b{i + 1}", _free_port(), ckpt, base, serve_args,
+                    quiet=not args.verbose,
+                )
+        # With N SO_REUSEPORT router workers each GET lands on ONE
+        # worker: require consecutive all-ready answers so every
+        # worker's registry (converging via registration heartbeats)
+        # has the fleet before the measured window starts.
+        need = max(1, 3 * args.router_workers)
+        streak = [0]
+
+        def all_ready():
+            if ready_count() == args.replicas:
+                streak[0] += 1
+            else:
+                streak[0] = 0
+            return streak[0] >= need
+
+        wait_until(
+            all_ready, args.ready_timeout,
+            f"all {args.replicas} replicas warm and in rotation "
+            "(every router worker)",
+            poll_s=0.5,
+        )
+        snap = registry_snapshot()
+        baseline_url = snap[0]["url"]
+        print(
+            f"fleet ready: router {base}, {args.replicas} replicas, "
+            f"baseline {baseline_url}", file=sys.stderr,
+        )
+
+        loadgen = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "loadgen.py")
+        if args.warm_s > 0:
+            subprocess.run(
+                [sys.executable, loadgen, "--url", base,
+                 "--connections", "32", "--duration", str(args.warm_s)],
+                check=True, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        out_path = args.out or os.path.join(workdir, "fleet_bench.json")
+        cmd = [
+            sys.executable, loadgen, "--url", base,
+            "--connections", str(args.connections),
+            "--duration", str(args.duration),
+            "--out", out_path,
+        ]
+        if not args.no_baseline:
+            cmd += ["--baseline-url", baseline_url,
+                    "--baseline-segments", str(args.baseline_segments)]
+        if args.rate_per_conn:
+            cmd += ["--rate-per-conn", str(args.rate_per_conn)]
+        print("loadgen: " + " ".join(cmd[1:]), file=sys.stderr)
+        res = subprocess.run(
+            cmd, stdout=subprocess.DEVNULL, timeout=args.duration * 4 + 300,
+        )
+        if res.returncode != 0:
+            raise AssertionError(f"loadgen exited {res.returncode}")
+
+        with open(out_path) as f:
+            art = json.load(f)
+        art["kind"] = "fleet_bench"
+        art["fleet_bench"] = {
+            "replicas": args.replicas,
+            "serve_args": serve_args,
+            "hedge_ms": args.hedge_ms,
+            "router_workers": args.router_workers or None,
+            "checkpoint": (
+                "stub" if args.stub_replicas
+                else "synthetic" if args.model is None else args.model
+            ),
+            # Multi-worker mode: these come over HTTP from whichever
+            # worker answered — one worker's view, labeled as such.
+            "upstream_pool": (
+                router.upstream.stats() if router is not None
+                else (http_json("/healthz") or {}).get("upstream")
+            ),
+            "registry": registry_snapshot(),
+        }
+        line = json.dumps(art, indent=1)
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+        print(line)
+
+        if args.metrics_out:
+            if router is not None:
+                page = REGISTRY.render_prometheus()
+            else:
+                import urllib.request
+
+                with urllib.request.urlopen(
+                    base + "/metrics", timeout=10
+                ) as resp:
+                    page = resp.read().decode()
+            with open(args.metrics_out, "w") as f:
+                f.write(page)
+            from validate_metrics import validate
+
+            problems = validate(page)
+            assert not problems, f"router /metrics invalid: {problems[:5]}"
+            print(f"metrics written to {args.metrics_out} "
+                  "(strict-validator clean)", file=sys.stderr)
+
+        # -- invariants -----------------------------------------------------
+        assert art["n_err"] == 0, (
+            f"client errors through the router: {art['n_err']}"
+        )
+        baseline = art.get("baseline")
+        if baseline is not None:
+            assert baseline["n_err"] == 0, (
+                f"client errors on the direct leg: {baseline['n_err']}"
+            )
+        qps = art["achieved_qps"]
+        overhead = (art.get("router_overhead_ms") or {}).get("p50")
+        msg = (
+            f"router: {qps} qps over {art['n_ok']} ok "
+            f"(p50 {art['latency_ms']['p50']} ms)"
+        )
+        if baseline is not None:
+            msg += (
+                f"; direct: {baseline['achieved_qps']} qps (p50 "
+                f"{baseline['latency_ms']['p50']} ms); "
+                f"router-added p50 {overhead} ms"
+            )
+        print(msg, file=sys.stderr)
+        if args.assert_qps is not None:
+            assert qps >= args.assert_qps, (
+                f"through-router qps {qps} < floor {args.assert_qps}"
+            )
+        if args.assert_overhead_ms is not None:
+            assert overhead is not None and \
+                overhead <= args.assert_overhead_ms, (
+                    f"router-added p50 {overhead} ms > ceiling "
+                    f"{args.assert_overhead_ms} ms"
+                )
+        print("FLEET BENCH PASS", file=sys.stderr)
+        rc = 0
+    finally:
+        if router_proc is not None and router_proc.poll() is None:
+            router_proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 30
+        for proc in list(procs.values()) + (
+            [router_proc] if router_proc is not None else []
+        ):
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if router is not None:
+            router.shutdown()
+        if jrn is not None:
+            journal.set_journal(None)
+            jrn.close()
+            print(f"journal written to {jrn.path}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

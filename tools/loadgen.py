@@ -66,6 +66,17 @@ the zero-downtime rolling-deploy proof reads straight out of one loadgen
 artifact (old version last seen at t, new version first seen ≈ t, ok
 counts on both sides).
 
+``--baseline-url URL`` measures *router-added overhead* in one artifact:
+the run is split into ``2 × --baseline-segments`` alternating slices —
+through-router (``--url``), direct-replica (``--baseline-url``), repeat —
+so both targets see the same host, the same thermal/noise environment,
+and the same client, interleaved in time rather than back to back.
+The artifact's primary numbers are the router side; a ``baseline`` block
+carries the direct side, and ``router_overhead_ms`` states the p50/p99/
+mean deltas as first-class fields — the "≤1 ms added p50" claim becomes
+machine-checkable instead of a hand-joined pair of runs
+(docs/FLEET.md "Router data plane").
+
 The server echoes (or assigns) an ``X-Request-Id`` on every reply; the
 worst-latency request ids land in the artifact (``worst_requests``), so a
 bench artifact can be joined against the server's ``/debug/requests``
@@ -882,7 +893,17 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
         for c in conns:
             if c.closed:
                 continue
-            if c.backoff_until and now >= c.backoff_until:
+            if c.backoff_until and c.pending_new and now >= stop:
+                # Paced connection idling past the end of the run:
+                # nothing in flight and nothing more to send — close now
+                # instead of sleeping to the next pacing tick, which
+                # would inflate the measured wall (and so deflate the
+                # reported qps) by up to one think-time interval.
+                c.backoff_until = 0.0
+                c.pending_new = False
+                c.closed = True
+                drop_socket(c)
+            elif c.backoff_until and now >= c.backoff_until:
                 c.backoff_until = 0.0
                 new = c.pending_new
                 c.pending_new = False
@@ -918,6 +939,82 @@ def run_closed_evloop(url, bodies, duration, connections, timeout, tally,
         "requests_on_final_connection_max": max(sent, default=0),
     }
     return wall, stats
+
+
+def _merge_conn_stats(acc: dict | None, cur: dict | None) -> dict | None:
+    """Fold one interleave slice's connection stats into the running
+    total (reuse accounting stays meaningful per target across slices)."""
+    if cur is None:
+        return acc
+    if acc is None:
+        return dict(cur)
+    for k in ("opened_total", "reconnects", "requests_total"):
+        acc[k] = acc.get(k, 0) + cur.get(k, 0)
+    acc["requests_on_final_connection_max"] = max(
+        acc.get("requests_on_final_connection_max", 0),
+        cur.get("requests_on_final_connection_max", 0),
+    )
+    acc["requests_per_connection_mean"] = round(
+        acc["requests_total"] / max(acc["opened_total"], 1), 2
+    )
+    return acc
+
+
+def run_interleaved_baseline(args, bodies, tally, tally_base, retry):
+    """The ``--baseline-url`` A/B driver: alternate through-router and
+    direct-replica slices of ``duration / (2 × segments)`` each, with
+    each target's outcomes accumulating into its own tally. Returns
+    (wall_router_s, wall_baseline_s, conn_stats_router,
+    conn_stats_baseline)."""
+    seg_s = args.duration / (2 * args.baseline_segments)
+    wall_r = wall_b = 0.0
+    cs_r = cs_b = None
+    for _ in range(args.baseline_segments):
+        for target, tly, is_router in (
+            (args.url, tally, True),
+            (args.baseline_url, tally_base, False),
+        ):
+            if args.connections:
+                w, cs = run_closed_evloop(
+                    target, bodies, seg_s, args.concurrency,
+                    args.timeout, tly, retry=retry,
+                    rate_per_conn=args.rate_per_conn,
+                )
+            else:
+                w, cs = run_closed(
+                    target, bodies, seg_s, args.concurrency,
+                    args.timeout, tly, retry=retry,
+                )
+            if is_router:
+                wall_r += w
+                cs_r = _merge_conn_stats(cs_r, cs)
+            else:
+                wall_b += w
+                cs_b = _merge_conn_stats(cs_b, cs)
+    return wall_r, wall_b, cs_r, cs_b
+
+
+def _overhead_block(router_ms: list[float], base_ms: list[float],
+                    segments: int) -> dict:
+    """``router_overhead_ms``: quantile deltas router-minus-direct from
+    the interleaved tallies. Null deltas when either side has no ok
+    replies (the claim needs evidence on both sides)."""
+    r, b = _percentiles(router_ms), _percentiles(base_ms)
+    return {
+        "segments_per_target": segments,
+        "p50": (
+            None if r["p50"] is None or b["p50"] is None
+            else round(r["p50"] - b["p50"], 3)
+        ),
+        "p99": (
+            None if r["p99"] is None or b["p99"] is None
+            else round(r["p99"] - b["p99"], 3)
+        ),
+        "mean": (
+            None if r["mean"] is None or b["mean"] is None
+            else round(r["mean"] - b["mean"], 3)
+        ),
+    }
 
 
 def _fire(
@@ -1092,6 +1189,20 @@ def main(argv=None) -> int:
         help="how the rate moves between --ramp points: step jumps and "
         "holds (default), linear interpolates",
     )
+    ap.add_argument(
+        "--baseline-url", default=None, metavar="URL",
+        help="measure router-added overhead: interleave slices against "
+        "--url (the router) and URL (a direct replica) in one run; the "
+        "artifact gains a baseline block and first-class "
+        "router_overhead_ms p50/p99/mean deltas. Closed mode only; "
+        "mutually exclusive with --perturb and --ramp",
+    )
+    ap.add_argument(
+        "--baseline-segments", type=int, default=3, metavar="N",
+        help="A/B interleave granularity for --baseline-url: the run "
+        "splits into 2xN alternating slices (default 3 per target) — "
+        "more slices decorrelate host noise/drift from the delta",
+    )
     ap.add_argument("--qps", type=float, default=100.0, help="open-loop rate")
     ap.add_argument("--timeout", type=float, default=30.0)
     ap.add_argument("--patient", help="patient JSON file (default: example)")
@@ -1166,6 +1277,17 @@ def main(argv=None) -> int:
     if args.rate_per_conn and not args.connections:
         ap.error("--rate-per-conn requires --connections (pacing is a "
                  "property of the event-loop client)")
+    if args.baseline_url:
+        if args.mode != "closed":
+            ap.error("--baseline-url requires --mode closed")
+        if args.perturb:
+            ap.error("--baseline-url and --perturb are mutually exclusive "
+                     "(a drifting cohort would confound the A/B delta)")
+        if args.ramp:
+            ap.error("--baseline-url and --ramp are mutually exclusive "
+                     "(a rate schedule cannot restart per slice)")
+        if args.baseline_segments < 1:
+            ap.error("--baseline-segments must be >= 1")
     schedule = None
     if args.ramp:
         if not args.connections:
@@ -1215,8 +1337,39 @@ def main(argv=None) -> int:
         cap_ms=args.retry_cap_ms,
     )
     tally = _Tally()
+    tally_base = _Tally() if args.baseline_url else None
+    baseline = overhead = None
     conn_stats = None
-    if args.mode == "closed":
+    if args.baseline_url:
+        wall, wall_b, conn_stats, cs_b = run_interleaved_baseline(
+            args, bodies, tally, tally_base, retry
+        )
+        offered = (
+            round(args.concurrency * args.rate_per_conn, 1)
+            if args.connections and args.rate_per_conn else None
+        )
+        nb = tally_base.n_ok + tally_base.n_shed + tally_base.n_err
+        baseline = {
+            "url": args.baseline_url,
+            "duration_s": round(wall_b, 3),
+            "achieved_qps": (
+                round(tally_base.n_ok / wall_b, 2) if wall_b > 0 else 0.0
+            ),
+            "n_sent": nb,
+            "n_ok": tally_base.n_ok,
+            "n_shed": tally_base.n_shed,
+            "n_err": tally_base.n_err,
+            "latency_ms": {
+                k: None if v is None else round(v, 3)
+                for k, v in _percentiles(tally_base.ok_latency_ms).items()
+            },
+            "connections": cs_b,
+        }
+        overhead = _overhead_block(
+            tally.ok_latency_ms, tally_base.ok_latency_ms,
+            args.baseline_segments,
+        )
+    elif args.mode == "closed":
         # --connections selects the single-threaded event-loop client:
         # at hundreds-to-thousands of connections a thread per worker
         # measures the client's own GIL scheduling, not the server.
@@ -1279,6 +1432,12 @@ def main(argv=None) -> int:
         # reconnects counts idle-reap races absorbed by a fresh-socket
         # resend. Null in open-loop mode.
         "connections": conn_stats,
+        # The --baseline-url A/B join (docs/FLEET.md "Router data
+        # plane"): the direct-replica side of the interleaved run, and
+        # the router-added latency deltas as first-class fields. Null
+        # without --baseline-url.
+        "baseline": baseline,
+        "router_overhead_ms": overhead,
         # Client-side resilience: how many sheds the retry policy absorbed
         # (n_shed counts only FINAL sheds — each one a give-up when
         # retries were on). Null when retries are disabled.

@@ -423,6 +423,32 @@ def _section_fleet(
             ],
         )
         rep.lines.append("")
+        # The balancer's own view (docs/FLEET.md "Router data plane"):
+        # the load signals least-loaded picking scores on, per replica —
+        # operators debug rotation skew from the same numbers the
+        # router picks with.
+        loads = [
+            (r.get("id"), r.get("load"))
+            for r in replicas if isinstance(r.get("load"), dict)
+        ]
+        if loads:
+            rep.table(
+                ("replica", "ewma latency (ms)", "outstanding",
+                 "queue depth", "pick score"),
+                [
+                    (
+                        rid,
+                        "-" if ld.get("ewma_latency_ms") is None
+                        else f"{ld['ewma_latency_ms']:.3f}",
+                        ld.get("outstanding"),
+                        "-" if ld.get("last_queue_depth") is None
+                        else ld.get("last_queue_depth"),
+                        ld.get("score"),
+                    )
+                    for rid, ld in loads
+                ],
+            )
+            rep.lines.append("")
     runtime = runtime or {}
     outcomes = runtime.get("fleet_requests_total")
     if isinstance(outcomes, dict):
@@ -458,6 +484,14 @@ def _section_fleet(
     if isinstance(per_replica, dict) and per_replica:
         rep.kv("per-replica attempts", ", ".join(
             f"{k}={v}" for k, v in sorted(per_replica.items()) if v
+        ))
+    conns = runtime.get("fleet_upstream_connections_total")
+    if isinstance(conns, dict) and any(conns.values()):
+        # opened ≈ replica count means keep-alive held across the run;
+        # opened ≈ request count means it did not.
+        rep.kv("upstream connections", ", ".join(
+            f"{k.split('=', 1)[1]}={v}" for k, v in sorted(conns.items())
+            if v
         ))
     registrations = [
         e for e in events if e.get("kind") == "fleet_replica_registered"
@@ -866,6 +900,24 @@ def _section_join(rep: Report, bench: dict | None, requests: dict | None):
         f"{lat.get(q)} ms" if lat.get(q) is not None else "-"
         for q in ("p50", "p95", "p99")
     ))
+    overhead = bench.get("router_overhead_ms")
+    if isinstance(overhead, dict):
+        # The --baseline-url A/B join (docs/FLEET.md "Router data
+        # plane"): through-router vs direct-replica, interleaved in one
+        # run — the router-added latency as measured, not inferred.
+        base = bench.get("baseline") or {}
+        base_lat = base.get("latency_ms") or {}
+        rep.kv(
+            "direct-replica baseline",
+            f"{base.get('url')} — {base.get('achieved_qps')} qps, p50 "
+            f"{base_lat.get('p50')} ms over {base.get('n_ok')} ok",
+        )
+        rep.kv(
+            "router-added overhead",
+            f"p50 {overhead.get('p50')} ms / p99 {overhead.get('p99')} ms"
+            f" / mean {overhead.get('mean')} ms (interleaved, "
+            f"{overhead.get('segments_per_target')} segments per target)",
+        )
     worst = bench.get("worst_requests") or []
     if not worst:
         rep.kv("worst_requests", "absent (pre-join loadgen artifact?)")
@@ -983,12 +1035,23 @@ def main(argv=None) -> int:
         # engine's traffic/SLO/quality story.
         if fleet_replicas is None and isinstance(metrics, dict):
             fleet_replicas = metrics.get("replicas")
+        if fleet_replicas is None and isinstance(bench, dict):
+            # A fleet_bench artifact carries the registry snapshot (with
+            # the per-replica load signals) taken at the end of its run
+            # — the offline stand-in for a live /fleet/replicas.
+            fleet_replicas = (bench.get("fleet_bench") or {}).get(
+                "registry"
+            )
         _section_fleet(
             rep, fleet_replicas, (metrics or {}).get("runtime"), events,
         )
         # The elastic-fleet timeline (autoscaler + lifecycle + rotation
         # events joined) renders whenever the journal set carries it.
         _section_autoscale(rep, events)
+        # A router bench artifact joins here too: achieved qps, the
+        # --baseline-url overhead deltas, and the worst-request trace
+        # join against the ROUTER's own flight recorder.
+        _section_join(rep, bench, requests)
         _section_tail(rep, requests, n=args.tail)
         if args.journal:
             _section_journal(rep, events)
