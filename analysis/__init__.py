@@ -1,0 +1,22 @@
+"""graftcheck — AST-driven invariant checker for this repo's own contracts.
+
+The framework carries load-bearing conventions that existed only as prose
+(CHANGES.md, docs/): the fleet tier is jax-free at import time, metric
+families register at import so the first scrape sees them, one loop thread
+owns every socket, duration math never reads the wall clock, the faultpoint
+catalog is closed. ``analysis`` turns each of those sentences into a
+machine-checked rule over the stdlib ``ast`` — no imports of the checked
+code, so checking the jax-free set cannot itself drag in jax.
+
+Layout:
+
+  ``analysis.core``     findings, per-line suppressions, the expiring
+                        baseline, source-file loading, the runner
+  ``analysis.project``  the repo-specific configuration (what to scan,
+                        the jax-free manifest, where the catalogs live)
+  ``analysis.rules``    one module per rule (see docs/ANALYSIS.md)
+
+CLI: ``python tools/graftcheck.py --strict`` (the CI gate).
+"""
+
+from analysis.core import Baseline, Finding, Project, run_rules  # noqa: F401

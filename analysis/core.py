@@ -1,0 +1,383 @@
+"""graftcheck core: findings, suppressions, expiring baseline, runner.
+
+Everything here is target-agnostic: a ``Project`` describes *what* to scan
+(a package directory plus tool scripts under one root) and the rule
+modules describe *what must hold*. The test suite exercises rules against
+tiny synthetic projects in a tmpdir through exactly this API, so CI and
+pytest enforce the same semantics.
+
+Suppression grammar (per line, checked code opts out locally)::
+
+    something_flagged()  # graftcheck: disable=rule-id
+    something_flagged()  # graftcheck: disable=rule-a,rule-b
+
+File-wide (anywhere in the file, normally the docstring tail)::
+
+    # graftcheck: disable-file=rule-id
+
+Baseline: a committed JSON list of grandfathered findings, each entry
+``{"rule", "path", "reason", "expires": "YYYY-MM-DD"}``. A matching
+finding is demoted to *baselined* until the expiry passes — then it is a
+failure again (debt has a due date). An entry that matches nothing is
+itself a failure: the baseline must shrink as violations are fixed, never
+accrete dead weight.
+"""
+
+from __future__ import annotations
+
+import ast
+import datetime
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+_SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable=([a-zA-Z0-9_,-]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftcheck:\s*disable-file=([a-zA-Z0-9_,-]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a file:line."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message,
+        }
+
+
+class SourceFile:
+    """One parsed python file: text, AST, and its suppression map."""
+
+    def __init__(self, root: str, abspath: str) -> None:
+        self.abspath = abspath
+        self.rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree: ast.Module | None = None
+        self.syntax_error: str | None = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.rel)
+        except SyntaxError as exc:
+            self.syntax_error = f"{type(exc).__name__}: {exc.msg}"
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        for i, line in enumerate(self.lines, start=1):
+            if "graftcheck" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.line_suppressions.setdefault(i, set()).update(
+                    m.group(1).split(",")
+                )
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.file_suppressions.update(m.group(1).split(","))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions:
+            return True
+        return rule in self.line_suppressions.get(line, ())
+
+
+class Project:
+    """What to scan and where the checked-in contracts live.
+
+    All paths are relative to ``root``. The defaults in
+    ``analysis.project.default_project`` describe this repo; tests build
+    Projects over synthetic trees in a tmpdir.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        package: str,
+        tool_dirs: tuple[str, ...] = ("tools",),
+        jaxfree: tuple[str, ...] = (),
+        forbidden_imports: tuple[str, ...] = ("jax", "jaxlib"),
+        catalog_path: str | None = None,
+        faults_path: str | None = None,
+        resilience_doc: str | None = None,
+        observability_doc: str | None = None,
+    ) -> None:
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.tool_dirs = tool_dirs
+        self.jaxfree = jaxfree
+        self.forbidden_imports = forbidden_imports
+        self.catalog_path = catalog_path
+        self.faults_path = faults_path
+        self.resilience_doc = resilience_doc
+        self.observability_doc = observability_doc
+        self._files: list[SourceFile] | None = None
+        self._by_module: dict[str, SourceFile] | None = None
+
+    # -- discovery -----------------------------------------------------------
+
+    def files(self) -> list[SourceFile]:
+        if self._files is None:
+            out: list[SourceFile] = []
+            pkg_root = os.path.join(self.root, self.package)
+            for dirpath, dirnames, filenames in os.walk(pkg_root):
+                dirnames[:] = [
+                    d for d in dirnames if d != "__pycache__"
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(
+                            SourceFile(self.root, os.path.join(dirpath, fn))
+                        )
+            for tool_dir in self.tool_dirs:
+                tdir = os.path.join(self.root, tool_dir)
+                if not os.path.isdir(tdir):
+                    continue
+                for dirpath, dirnames, filenames in os.walk(tdir):
+                    dirnames[:] = [
+                        d for d in dirnames if d != "__pycache__"
+                    ]
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            out.append(
+                                SourceFile(
+                                    self.root, os.path.join(dirpath, fn)
+                                )
+                            )
+            self._files = out
+        return self._files
+
+    def by_module(self) -> dict[str, SourceFile]:
+        """Dotted module name -> SourceFile (``a/b/__init__.py`` -> ``a.b``;
+        tool scripts as ``tools.name``)."""
+        if self._by_module is None:
+            out = {}
+            for sf in self.files():
+                rel = sf.rel
+                if rel.endswith("/__init__.py"):
+                    mod = rel[: -len("/__init__.py")]
+                elif rel.endswith(".py"):
+                    mod = rel[:-3]
+                else:
+                    continue
+                out[mod.replace("/", ".")] = sf
+            self._by_module = out
+        return self._by_module
+
+    def read_doc(self, relpath: str) -> str | None:
+        p = os.path.join(self.root, relpath)
+        if not os.path.exists(p):
+            return None
+        with open(p, encoding="utf-8") as f:
+            return f.read()
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    """The baseline file itself is malformed."""
+
+
+class Baseline:
+    """Committed grandfathered findings with expiry dates."""
+
+    def __init__(self, entries: list[dict]) -> None:
+        for e in entries:
+            missing = {"rule", "path", "reason", "expires"} - set(e)
+            if missing:
+                raise BaselineError(
+                    f"baseline entry {e!r} missing keys {sorted(missing)}"
+                )
+            try:
+                datetime.date.fromisoformat(e["expires"])
+            except ValueError:
+                raise BaselineError(
+                    f"baseline entry for {e['rule']}:{e['path']} has "
+                    f"unparseable expires {e['expires']!r} (want YYYY-MM-DD)"
+                ) from None
+        self.entries = entries
+        self._hits: set[int] = set()
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, list):
+            raise BaselineError("baseline must be a JSON list of entries")
+        return cls(data)
+
+    def match(self, finding: Finding, today: datetime.date) -> str | None:
+        """``"active"`` (suppressed), ``"expired"`` (fails again), or None
+        (not baselined). Match granularity is (rule, path): line numbers
+        churn with unrelated edits and must not invalidate the entry."""
+        for i, e in enumerate(self.entries):
+            if e["rule"] == finding.rule and e["path"] == finding.path:
+                self._hits.add(i)
+                expires = datetime.date.fromisoformat(e["expires"])
+                return "active" if today <= expires else "expired"
+        return None
+
+    def unused(self) -> list[dict]:
+        return [
+            e for i, e in enumerate(self.entries) if i not in self._hits
+        ]
+
+
+# -- runner -----------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    """Outcome of one checker run, after suppressions and baseline."""
+
+    findings: list[Finding] = field(default_factory=list)  # live failures
+    baselined: list[tuple[Finding, dict]] = field(default_factory=list)
+    expired: list[tuple[Finding, dict]] = field(default_factory=list)
+    unused_baseline: list[dict] = field(default_factory=list)
+    suppressed_count: int = 0
+    rules_run: list[str] = field(default_factory=list)
+    files_scanned: int = 0
+
+    def failed(self) -> bool:
+        return bool(
+            self.findings or self.expired or self.unused_baseline
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "rules_run": self.rules_run,
+            "files_scanned": self.files_scanned,
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [
+                {**f.to_json(), "expires": e["expires"],
+                 "reason": e["reason"]}
+                for f, e in self.baselined
+            ],
+            "expired": [
+                {**f.to_json(), "expires": e["expires"],
+                 "reason": e["reason"]}
+                for f, e in self.expired
+            ],
+            "unused_baseline": self.unused_baseline,
+            "suppressed": self.suppressed_count,
+            "failed": self.failed(),
+        }
+
+
+def run_rules(
+    project: Project,
+    rules,
+    baseline: Baseline | None = None,
+    today: datetime.date | None = None,
+) -> Report:
+    """Run every rule over the project; apply suppressions, then the
+    baseline. ``rules`` is an iterable of modules/objects exposing
+    ``RULE_ID`` and ``check(project) -> list[Finding]``."""
+    baseline = baseline or Baseline([])
+    today = today or datetime.date.today()
+    report = Report()
+    report.files_scanned = len(project.files())
+    by_rel = {sf.rel: sf for sf in project.files()}
+
+    raw: list[Finding] = []
+    # A file the parser rejects can hide anything; surface it as its own
+    # finding instead of silently skipping the file in every rule.
+    for sf in project.files():
+        if sf.syntax_error:
+            raw.append(Finding(
+                "parse", sf.rel, 1, f"unparseable file: {sf.syntax_error}"
+            ))
+    for rule in rules:
+        report.rules_run.append(rule.RULE_ID)
+        raw.extend(rule.check(project))
+
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            report.suppressed_count += 1
+            continue
+        status = baseline.match(f, today)
+        if status == "active":
+            entry = next(
+                e for e in baseline.entries
+                if e["rule"] == f.rule and e["path"] == f.path
+            )
+            report.baselined.append((f, entry))
+        elif status == "expired":
+            entry = next(
+                e for e in baseline.entries
+                if e["rule"] == f.rule and e["path"] == f.path
+            )
+            report.expired.append((f, entry))
+        else:
+            report.findings.append(f)
+    # A baseline entry can only be proven stale by a rule that actually
+    # ran: under a --rules subset, entries for unrun rules are simply
+    # out of scope, not failures.
+    ran = set(report.rules_run)
+    report.unused_baseline = [
+        e for e in baseline.unused() if e["rule"] in ran
+    ]
+    return report
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str | None:
+    """``foo(...)`` -> ``foo``; ``a.b.foo(...)`` -> ``foo``; else None."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def dotted(node: ast.expr) -> str | None:
+    """Render a Name/Attribute chain as ``a.b.c`` (None if not a chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.expr | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def literal_dict(path: str, tree: ast.Module, name: str):
+    """The literal value assigned to module-level ``name`` (via
+    ``ast.literal_eval``), or None when absent/non-literal."""
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                try:
+                    return ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return None
+    return None

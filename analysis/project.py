@@ -1,0 +1,77 @@
+"""The repo-specific graftcheck configuration — the checked-in manifests.
+
+This module is the single source of truth for WHICH modules are declared
+jax-free (rule ``import-purity``). The prose that used to make that claim
+("Deliberately jax-free: a router process starts in milliseconds…") now
+cites the rule id; this list is what CI actually proves.
+"""
+
+from __future__ import annotations
+
+import os
+
+from analysis.core import Project
+
+PACKAGE = "machine_learning_replications_tpu"
+
+#: Modules whose TRANSITIVE import-time closure must never reach jax or
+#: jaxlib. Parent packages count — importing ``a.b.c`` executes
+#: ``a/__init__`` and ``a/b/__init__`` first, so an eager re-export in an
+#: ``__init__`` breaks the child's purity (exactly how ``score.reader``
+#: was found reaching jax through ``data/__init__`` before PR 13).
+JAXFREE = (
+    # The fleet tier: a router/autoscaler process starts in milliseconds
+    # on hosts with no accelerator stack (docs/FLEET.md).
+    f"{PACKAGE}.fleet",
+    f"{PACKAGE}.fleet.autoscale",
+    f"{PACKAGE}.fleet.deploy",
+    f"{PACKAGE}.fleet.health",
+    f"{PACKAGE}.fleet.lifecycle",
+    f"{PACKAGE}.fleet.registry",
+    f"{PACKAGE}.fleet.router",
+    # The continual-learning trigger polls replicas over HTTP; it runs
+    # beside the router (docs/CONTINUAL.md).
+    f"{PACKAGE}.learn.trigger",
+    # Provenance and metrics: bench.py's orchestrator must never touch
+    # the TPU plugin (obs/journal.py module docstring).
+    f"{PACKAGE}.obs.journal",
+    f"{PACKAGE}.obs.registry",
+    # Bulk-score input parsing: the reader side of the score pipeline
+    # (host-only parse/validate/quarantine) stays importable without jax.
+    f"{PACKAGE}.score.reader",
+    # Ops tooling that must run against live processes from bare hosts.
+    "tools.loadgen",
+    "tools.chaos_drill",
+    "tools.obs_report",
+    "tools.validate_metrics",
+    "tools.fleet_bench",
+    "tools.graftcheck",
+)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_project(root: str | None = None) -> Project:
+    """The Project describing this repository."""
+    return Project(
+        root=root or repo_root(),
+        package=PACKAGE,
+        tool_dirs=("tools", "analysis"),
+        jaxfree=JAXFREE,
+        # flax is forbidden alongside jax: importing flax imports jax
+        # unconditionally (flax.core pulls jax at its own import time),
+        # so a flax edge IS a jax edge — the empirically traced chain
+        # score/__init__ -> … -> models/scaler.py -> flax -> jax was
+        # invisible until flax joined this set.
+        forbidden_imports=("jax", "jaxlib", "flax"),
+        catalog_path=f"{PACKAGE}/obs/catalog.py",
+        faults_path=f"{PACKAGE}/resilience/faults.py",
+        resilience_doc="docs/RESILIENCE.md",
+        observability_doc="docs/OBSERVABILITY.md",
+    )
+
+
+def baseline_path(root: str | None = None) -> str:
+    return os.path.join(root or repo_root(), "analysis", "baseline.json")

@@ -1,0 +1,19 @@
+"""Rule plugins. ``ALL_RULES`` is the closed, ordered set the CLI runs."""
+
+from analysis.rules import (
+    faultpoints,
+    import_purity,
+    journal_catalog,
+    loop_discipline,
+    metrics_catalog,
+    monotonic_clock,
+)
+
+ALL_RULES = (
+    import_purity,
+    loop_discipline,
+    metrics_catalog,
+    journal_catalog,
+    monotonic_clock,
+    faultpoints,
+)

@@ -1,0 +1,113 @@
+"""Rule ``faultpoint-coherence`` (R6): the three views of the faultpoint
+catalog agree exactly.
+
+A faultpoint exists in three places: the ``fire("site")`` call woven into
+a hot path, the closed ``SITES`` catalog in ``resilience/faults.py`` that
+arm-time validation checks against, and the operator-facing table in
+docs/RESILIENCE.md that chaos drills are written from. The three drifting
+is how a chaos spec "passes" while injecting nothing. Statically:
+
+  * every ``faults.fire("x")`` site literal appears in ``SITES``;
+  * every ``SITES`` entry has at least one ``fire`` site (a cataloged
+    faultpoint nothing fires is dead chaos surface);
+  * the site names in docs/RESILIENCE.md's catalog table (the
+    ``| `site` |`` rows) equal the ``SITES`` keys exactly;
+  * ``fire`` is never called with a computed site name.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from analysis.core import Finding, Project, literal_dict, str_const
+
+RULE_ID = "faultpoint-coherence"
+
+_DOC_SITE_RE = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`", re.MULTILINE)
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    if not project.faults_path:
+        return findings
+    faults_sf = next(
+        (s for s in project.files() if s.rel == project.faults_path), None
+    )
+    if faults_sf is None or faults_sf.tree is None:
+        return [Finding(
+            RULE_ID, project.faults_path, 1,
+            "faultpoint catalog module missing or unparseable",
+        )]
+    sites_catalog = literal_dict(
+        project.faults_path, faults_sf.tree, "SITES"
+    )
+    if not isinstance(sites_catalog, dict):
+        return [Finding(
+            RULE_ID, faults_sf.rel, 1,
+            "SITES must be a literal dict of site -> supported modes",
+        )]
+
+    fired: dict[str, list[tuple[str, int]]] = {}
+    for sf in project.files():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if name != "fire" or not node.args:
+                continue
+            site = str_const(node.args[0])
+            if site is None:
+                # fire(site) inside faults.py itself is the dispatcher;
+                # a computed site anywhere else defeats arm-time checking
+                if sf.rel != project.faults_path:
+                    findings.append(Finding(
+                        RULE_ID, sf.rel, node.lineno,
+                        "faults.fire() with a computed site name — sites "
+                        "are a closed catalog",
+                    ))
+                continue
+            fired.setdefault(site, []).append((sf.rel, node.lineno))
+
+    for site, where in sorted(fired.items()):
+        if site not in sites_catalog:
+            rel, line = where[0]
+            findings.append(Finding(
+                RULE_ID, rel, line,
+                f"fire({site!r}) references a site missing from the "
+                f"SITES catalog in {project.faults_path}",
+            ))
+    for site in sorted(set(sites_catalog) - set(fired)):
+        findings.append(Finding(
+            RULE_ID, faults_sf.rel, 1,
+            f"SITES entry {site!r} has no fire() site anywhere — dead "
+            "chaos surface",
+        ))
+
+    if project.resilience_doc:
+        doc = project.read_doc(project.resilience_doc)
+        if doc is None:
+            findings.append(Finding(
+                RULE_ID, faults_sf.rel, 1,
+                f"cross-check doc {project.resilience_doc} not found",
+            ))
+        else:
+            doc_sites = set(_DOC_SITE_RE.findall(doc))
+            for site in sorted(set(sites_catalog) - doc_sites):
+                findings.append(Finding(
+                    RULE_ID, faults_sf.rel, 1,
+                    f"site {site!r} is in SITES but missing from the "
+                    f"{project.resilience_doc} catalog table",
+                ))
+            for site in sorted(doc_sites - set(sites_catalog)):
+                findings.append(Finding(
+                    RULE_ID, faults_sf.rel, 1,
+                    f"{project.resilience_doc} documents site {site!r} "
+                    "which is not in SITES",
+                ))
+    return findings

@@ -1,0 +1,145 @@
+"""Rule ``import-purity`` (R1): the declared jax-free set stays jax-free.
+
+Builds the module-level (import-time) import graph over the scanned
+files and proves that no module in the project's ``jaxfree`` manifest
+transitively reaches a forbidden top-level distribution (``jax``,
+``jaxlib``). Function-scoped imports are deliberately excluded — a lazy
+``import jax`` inside a predict path is exactly how the serving stack
+keeps the fleet tier importable in milliseconds.
+
+Python semantics the graph models (both have bitten this repo):
+
+  * importing ``a.b.c`` executes ``a/__init__`` and ``a/b/__init__``
+    first — an eager re-export in a parent package breaks every child's
+    purity;
+  * ``from a.b import c`` may bind submodule ``a.b.c``, so that edge is
+    resolved when ``a/b/c.py`` exists.
+
+Module-level imports guarded by ``if``/``try`` are counted: an
+import-time dependency that only *sometimes* fires is still an
+import-time dependency.
+
+The finding reports the full offending chain (root -> … -> jax) so the
+fix site is obvious.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Project
+
+RULE_ID = "import-purity"
+
+
+def _module_level_imports(tree: ast.Module):
+    """Yield (imported name, line) for import statements that execute at
+    module import time, including under module-level if/try."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield alias.name, node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                yield node.module, node.lineno
+                for alias in node.names:
+                    # may be a submodule import
+                    yield f"{node.module}.{alias.name}", node.lineno
+            # relative imports (level > 0) don't occur in this repo's
+            # style; absolute-only keeps resolution exact.
+        elif isinstance(node, (ast.If, ast.Try)):
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                for child in getattr(node, field, []):
+                    if isinstance(child, ast.ExceptHandler):
+                        stack.extend(child.body)
+                    else:
+                        stack.append(child)
+
+
+def build_graph(project: Project):
+    """module -> list of (imported dotted name, line)."""
+    graph = {}
+    for mod, sf in project.by_module().items():
+        if sf.tree is None:
+            graph[mod] = []
+            continue
+        graph[mod] = list(_module_level_imports(sf.tree))
+    return graph
+
+
+def _resolve_internal(name: str, graph) -> list[str]:
+    """Internal modules executed by importing ``name`` (every matching
+    package prefix, deepest last)."""
+    out = []
+    parts = name.split(".")
+    for i in range(1, len(parts) + 1):
+        prefix = ".".join(parts[:i])
+        if prefix in graph:
+            out.append(prefix)
+    return out
+
+
+def trace(root: str, graph, forbidden: tuple[str, ...]):
+    """BFS from ``root`` over import-time edges; returns the first chain
+    reaching a forbidden distribution as a list
+    ``[root, …, module, forbidden]``, or None when pure."""
+    if root not in graph:
+        return ["<missing>"]
+    parents: dict[str, tuple[str, int] | None] = {root: None}
+    queue = [root]
+    while queue:
+        mod = queue.pop(0)
+        edges = list(graph.get(mod, []))
+        # importing a module executes its parent packages too
+        parts = mod.split(".")
+        for i in range(1, len(parts)):
+            prefix = ".".join(parts[:i])
+            if prefix in graph:
+                edges.append((prefix, 0))
+        for name, line in edges:
+            top = name.split(".")[0]
+            if top in forbidden:
+                chain = [f"{name} (line {line})"]
+                cur: str | None = mod
+                while cur is not None:
+                    chain.append(cur)
+                    nxt = parents[cur]
+                    cur = nxt[0] if nxt else None
+                return list(reversed(chain))
+            for internal in _resolve_internal(name, graph):
+                if internal not in parents:
+                    parents[internal] = (mod, line)
+                    queue.append(internal)
+    return None
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    if not project.jaxfree:
+        return findings
+    graph = build_graph(project)
+    by_module = project.by_module()
+    for root in project.jaxfree:
+        chain = trace(root, graph, project.forbidden_imports)
+        if chain == ["<missing>"]:
+            findings.append(Finding(
+                RULE_ID, "analysis/project.py", 1,
+                f"jax-free manifest names {root!r} but no such module "
+                "exists in the scanned tree",
+            ))
+        elif chain is not None:
+            # anchor the finding at the last internal module's import line
+            sf = by_module.get(chain[-2]) if len(chain) >= 2 else None
+            path = sf.rel if sf else by_module[root].rel
+            line = 1
+            tail = chain[-1]
+            if "(line " in tail:
+                line = int(tail.rsplit("(line ", 1)[1].rstrip(")"))
+            findings.append(Finding(
+                RULE_ID, path, line,
+                f"declared jax-free module {root!r} reaches a forbidden "
+                f"import at import time: {' -> '.join(chain)}",
+            ))
+    return findings

@@ -1,0 +1,118 @@
+"""Rule ``journal-catalog`` (R4): every journal event is declared, with
+its required keys.
+
+The JSONL run journal is the system's flight recorder: drills, the
+continual-learning trigger, and ``tools/obs_report.py`` all *grep it by
+event name* and index into event fields. A typo'd name (``fleet_rotaton``)
+or a dropped key used to fail silently — the consumer just saw nothing.
+Statically enforced over every ``…event("name", key=…)`` call site
+(``journal.event`` module function, ``RunJournal.event`` method, and the
+re-exported ``event`` alias inside ``obs/journal.py``):
+
+  * the event kind is a string LITERAL and appears in the ``EVENTS``
+    catalog (``obs/catalog.py``);
+  * the call carries every required key for that kind as an explicit
+    keyword (a ``**spread`` at the call site satisfies the remainder —
+    the spread's contents are a runtime matter);
+  * every catalog entry is emitted by at least one site (no dead names).
+
+``threading.Event()`` and similar constructors don't collide: the rule
+matches only lowercase ``event`` call targets with a literal string
+first argument.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Project, literal_dict, str_const
+
+RULE_ID = "journal-catalog"
+
+
+def collect_sites(project: Project):
+    """(sf, line, kind-name-or-None, literal kwargs, has_spread)."""
+    sites = []
+    for sf in project.files():
+        if sf.tree is None:
+            continue
+        if project.catalog_path and sf.rel == project.catalog_path:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if name != "event":
+                continue
+            if not node.args:
+                continue
+            kind = str_const(node.args[0])
+            kwargs = frozenset(
+                kw.arg for kw in node.keywords if kw.arg is not None
+            )
+            spread = any(kw.arg is None for kw in node.keywords)
+            sites.append((sf, node.lineno, kind, kwargs, spread,
+                          node.args[0].lineno if node.args else node.lineno))
+    return sites
+
+
+def load_catalog(project: Project):
+    if not project.catalog_path:
+        return None, None
+    sf = next(
+        (s for s in project.files() if s.rel == project.catalog_path), None
+    )
+    if sf is None or sf.tree is None:
+        return None, None
+    return literal_dict(project.catalog_path, sf.tree, "EVENTS"), sf
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    sites = collect_sites(project)
+    catalog, catalog_sf = load_catalog(project)
+    if catalog is None:
+        if project.catalog_path and sites:
+            findings.append(Finding(
+                RULE_ID, project.catalog_path, 1,
+                "journal-event catalog (EVENTS literal dict) missing or "
+                "unparseable",
+            ))
+        return findings
+
+    emitted: set[str] = set()
+    for sf, line, kind, kwargs, spread, _ in sites:
+        if kind is None:
+            findings.append(Finding(
+                RULE_ID, sf.rel, line,
+                "journal event kind must be a string literal (a computed "
+                "kind cannot be cataloged or grepped)",
+            ))
+            continue
+        emitted.add(kind)
+        required = catalog.get(kind)
+        if required is None:
+            findings.append(Finding(
+                RULE_ID, sf.rel, line,
+                f"journal event {kind!r} is not in the EVENTS catalog "
+                f"({project.catalog_path})",
+            ))
+            continue
+        if not spread:
+            missing = [k for k in required if k not in kwargs]
+            if missing:
+                findings.append(Finding(
+                    RULE_ID, sf.rel, line,
+                    f"journal event {kind!r} missing required keys "
+                    f"{missing} (catalog requires {list(required)})",
+                ))
+    for kind in sorted(set(catalog) - emitted):
+        findings.append(Finding(
+            RULE_ID, catalog_sf.rel, 1,
+            f"EVENTS catalog entry {kind!r} is emitted nowhere — remove "
+            "it or restore the emit site",
+        ))
+    return findings

@@ -1,0 +1,200 @@
+"""Rule ``loop-discipline`` (R2): the event loop never blocks, and
+cross-thread entry points never touch loop-only internals.
+
+Functions decorated ``@loop_only`` / ``@cross_thread``
+(``machine_learning_replications_tpu.contracts``) declare which thread
+may run them. Statically enforced, per decorated function body:
+
+  * inside ``@loop_only``: no blocking primitives —
+
+      - ``time.sleep``
+      - ``socket.create_connection`` / ``<sock>.connect`` (the loop
+        uses non-blocking ``connect_ex``), ``<sock>.makefile``
+      - anything reached through ``http.client``
+      - ``<lock>.acquire()`` with no ``timeout=``/``blocking=False``
+        (an un-timed acquire is an unbounded stall for every socket the
+        loop owns; ``with lock:`` around plain state is fine — the rule
+        targets the explicit-acquire pattern used for long holds)
+      - un-timed ``<thread>.join()``
+
+  * inside ``@cross_thread``: no direct calls (``self.x()`` / ``obj.x()``
+    / bare ``x()``) to any name declared ``@loop_only`` anywhere in the
+    same file — cross-thread code must marshal through the wake pipe
+    (``_post``/``call_later``), never run loop internals off-thread;
+
+  * one function must not carry both decorators.
+
+The check is name-based within one file — the honest scope for a stdlib
+AST: it will not follow a call through an alias or another module.
+That covers the real hazard (a maintainer "just calling" a loop method
+from a handler thread three lines away) without pretending to be a type
+system.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Project, dotted
+
+RULE_ID = "loop-discipline"
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep() blocks the event loop",
+    "socket.create_connection":
+        "socket.create_connection() is a blocking connect "
+        "(use non-blocking connect_ex through the loop)",
+}
+_BLOCKING_PREFIXES = {
+    "http.client": "http.client is a blocking HTTP stack "
+    "(use the loop-owned UpstreamPool)",
+}
+_BLOCKING_METHODS = {
+    "connect": "blocking socket connect (use connect_ex on a "
+    "non-blocking socket)",
+    "makefile": "socket.makefile() wraps the socket in blocking "
+    "file I/O",
+}
+# ``.get()`` is deliberately NOT here: a bare no-arg ``get`` is the
+# metric-family child accessor (``FAMILY.get().inc()``) all over the
+# loop's hot paths; a blocking queue read would be ``get(timeout=…)``,
+# which no list can tell from ``dict.get(k, d)`` by name alone.
+_UNTIMED_METHODS = {
+    "acquire": "un-timed Lock.acquire() can stall the loop forever "
+    "(pass timeout= or blocking=False)",
+    "join": "un-timed join() blocks the loop (pass timeout=)",
+}
+
+
+def _decorations(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    out = set()
+    for dec in fn.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        name = node.attr if isinstance(node, ast.Attribute) else (
+            node.id if isinstance(node, ast.Name) else None
+        )
+        if name in ("loop_only", "cross_thread"):
+            out.add(name)
+    return out
+
+
+def _is_bounded(call: ast.Call, meth: str) -> bool:
+    """True when an acquire()/join() call provably cannot block forever:
+    a ``timeout=`` keyword, or (acquire only) a first argument /
+    ``blocking=`` keyword that is literally False. ``acquire(True)`` and
+    ``acquire(blocking=True)`` are exactly the un-timed blocking acquire
+    the rule exists to ban — an argument's presence is not boundedness."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if meth == "acquire" and kw.arg == "blocking":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False)
+    if call.args:
+        if meth == "acquire":
+            first = call.args[0]
+            if isinstance(first, ast.Constant) and first.value is False:
+                return True
+            # acquire(True, 5) / acquire(False, anything): a second
+            # positional is the timeout
+            return len(call.args) > 1
+        return True  # join(5) — positional timeout
+    return False
+
+
+def _own_body_calls(fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """Call nodes in fn's body, excluding nested function/class defs —
+    a closure handed to call_later runs later ON the loop, so its body
+    is not this function's thread context."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_loop_only_body(fn, sf_rel: str) -> list[Finding]:
+    findings = []
+    for call in _own_body_calls(fn):
+        chain = dotted(call.func)
+        if chain in _BLOCKING_DOTTED:
+            findings.append(Finding(
+                RULE_ID, sf_rel, call.lineno,
+                f"@loop_only {fn.name}: {_BLOCKING_DOTTED[chain]}",
+            ))
+            continue
+        if chain:
+            for prefix, why in _BLOCKING_PREFIXES.items():
+                if chain == prefix or chain.startswith(prefix + "."):
+                    findings.append(Finding(
+                        RULE_ID, sf_rel, call.lineno,
+                        f"@loop_only {fn.name}: {why}",
+                    ))
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            if meth in _BLOCKING_METHODS:
+                findings.append(Finding(
+                    RULE_ID, sf_rel, call.lineno,
+                    f"@loop_only {fn.name}: {_BLOCKING_METHODS[meth]}",
+                ))
+            elif meth in _UNTIMED_METHODS:
+                timed = _is_bounded(call, meth)
+                if not timed:
+                    findings.append(Finding(
+                        RULE_ID, sf_rel, call.lineno,
+                        f"@loop_only {fn.name}: {_UNTIMED_METHODS[meth]}",
+                    ))
+    return findings
+
+
+def _check_cross_thread_body(fn, loop_only_names: set[str],
+                             sf_rel: str) -> list[Finding]:
+    findings = []
+    for call in _own_body_calls(fn):
+        f = call.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name in loop_only_names:
+            findings.append(Finding(
+                RULE_ID, sf_rel, call.lineno,
+                f"@cross_thread {fn.name} calls @loop_only {name}() "
+                "directly; marshal onto the loop (_post / call_later) "
+                "instead",
+            ))
+    return findings
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files():
+        if sf.tree is None or "loop_only" not in sf.text:
+            continue
+        decorated: list[tuple[ast.FunctionDef, set[str]]] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                marks = _decorations(node)
+                if marks:
+                    decorated.append((node, marks))
+        loop_only_names = {
+            fn.name for fn, marks in decorated if "loop_only" in marks
+        }
+        for fn, marks in decorated:
+            if marks == {"loop_only", "cross_thread"}:
+                findings.append(Finding(
+                    RULE_ID, sf.rel, fn.lineno,
+                    f"{fn.name} is annotated both @loop_only and "
+                    "@cross_thread — a function has one thread contract",
+                ))
+                continue
+            if "loop_only" in marks:
+                findings.extend(_check_loop_only_body(fn, sf.rel))
+            if "cross_thread" in marks:
+                findings.extend(_check_cross_thread_body(
+                    fn, loop_only_names - {fn.name}, sf.rel
+                ))
+    return findings

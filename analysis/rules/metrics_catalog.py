@@ -1,0 +1,241 @@
+"""Rule ``metrics-catalog`` (R3): every metric family is declared,
+import-time-registered, consistently labeled, and documented.
+
+The contract (CHANGES.md PR 6/11, docs/OBSERVABILITY.md): a scrape of a
+freshly started process sees every family's metadata — no family may
+first appear when it first fires. Statically enforced over every
+``<registry>.counter/gauge/histogram("name", …)`` call site:
+
+  * the family name is a string LITERAL (a name built at runtime can't
+    be cataloged, alerted on, or grepped);
+  * names follow the repo's Prometheus conventions: ``[a-z][a-z0-9_]*``,
+    counters end in ``_total``, no family name ends in ``_bucket`` /
+    ``_sum`` / ``_count`` (histogram sample suffixes), no ``le`` label;
+  * one label set and one kind per family across all sites;
+  * calls on the process-global ``REGISTRY`` happen at module top level
+    (import-time registration). Instance registries (a ``MetricsRegistry``
+    passed into a component, e.g. ``obs.slo``/``obs.quality``) are exempt
+    from placement — their import-time guarantee is the component's
+    constructor contract — but their names are still cataloged;
+  * every name appears in the ``METRICS`` catalog
+    (``obs/catalog.py``) with matching kind and labels, every catalog
+    entry is registered by some site, and every catalog name appears in
+    docs/OBSERVABILITY.md's family table.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from analysis.core import Finding, Project, literal_dict, str_const
+
+RULE_ID = "metrics-catalog"
+
+_KINDS = ("counter", "gauge", "histogram")
+_NAME_RE = re.compile(r"[a-z][a-z0-9_]*\Z")
+_RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class _Site:
+    __slots__ = ("sf", "line", "kind", "name", "labels", "top_level",
+                 "global_registry", "literal")
+
+    def __init__(self, sf, line, kind, name, labels, top_level,
+                 global_registry, literal):
+        self.sf = sf
+        self.line = line
+        self.kind = kind
+        self.name = name
+        self.labels = labels
+        self.top_level = top_level
+        self.global_registry = global_registry
+        self.literal = literal
+
+
+def _labels_of(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg in ("labels", "label_names"):
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = [str_const(e) for e in kw.value.elts]
+                if all(v is not None for v in vals):
+                    return tuple(vals)
+            return None  # non-literal label list
+    return ()
+
+
+def collect_sites(project: Project) -> list[_Site]:
+    sites = []
+    for sf in project.files():
+        if sf.tree is None:
+            continue
+        if project.catalog_path and sf.rel == project.catalog_path:
+            continue
+        depth = {"n": 0}
+
+        def walk(node, depth=depth, sf=sf):
+            nested = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            if nested:
+                depth["n"] += 1
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _KINDS:
+                    recv = f.value
+                    recv_name = recv.attr if isinstance(
+                        recv, ast.Attribute
+                    ) else (recv.id if isinstance(recv, ast.Name) else None)
+                    if recv_name and (
+                        recv_name == "REGISTRY"
+                        or recv_name.lower().lstrip("_") in
+                        ("reg", "registry")
+                    ):
+                        name = str_const(node.args[0]) if node.args else None
+                        sites.append(_Site(
+                            sf, node.lineno, f.attr, name,
+                            _labels_of(node), depth["n"] == 0,
+                            recv_name == "REGISTRY", name is not None,
+                        ))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+            if nested:
+                depth["n"] -= 1
+
+        for top in sf.tree.body:
+            walk(top)
+    return sites
+
+
+def load_catalog(project: Project):
+    """Parse METRICS from the catalog module without importing it."""
+    if not project.catalog_path:
+        return None, None
+    sf = next(
+        (s for s in project.files() if s.rel == project.catalog_path), None
+    )
+    if sf is None or sf.tree is None:
+        return None, None
+    return literal_dict(project.catalog_path, sf.tree, "METRICS"), sf
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    sites = collect_sites(project)
+    catalog, catalog_sf = load_catalog(project)
+
+    by_name: dict[str, list[_Site]] = {}
+    for s in sites:
+        if not s.literal:
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"metric family name must be a string literal "
+                f"({s.kind} registration with a computed name)",
+            ))
+            continue
+        by_name.setdefault(s.name, []).append(s)
+        if not _NAME_RE.match(s.name):
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"metric name {s.name!r} violates naming convention "
+                "[a-z][a-z0-9_]*",
+            ))
+        if s.kind == "counter" and not s.name.endswith("_total"):
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"counter family {s.name!r} must end in _total "
+                "(Prometheus counter convention)",
+            ))
+        for suffix in _RESERVED_SUFFIXES:
+            if s.name.endswith(suffix):
+                findings.append(Finding(
+                    RULE_ID, s.sf.rel, s.line,
+                    f"family name {s.name!r} ends in reserved histogram "
+                    f"sample suffix {suffix!r}",
+                ))
+        if s.labels is not None and "le" in s.labels:
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"family {s.name!r} declares reserved label 'le'",
+            ))
+        if s.global_registry and not s.top_level:
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"family {s.name!r} registers on the process-global "
+                "REGISTRY inside a function/method — families register "
+                "at module import so the first scrape sees them",
+            ))
+
+    for name, group in sorted(by_name.items()):
+        kinds = {s.kind for s in group}
+        if len(kinds) > 1:
+            s = group[1]
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"family {name!r} registered with conflicting kinds "
+                f"{sorted(kinds)}",
+            ))
+        label_sets = {s.labels for s in group if s.labels is not None}
+        if len(label_sets) > 1:
+            s = group[1]
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"family {name!r} registered with conflicting label sets "
+                f"{sorted(label_sets)}",
+            ))
+
+    if catalog is None:
+        if project.catalog_path and sites:
+            findings.append(Finding(
+                RULE_ID, project.catalog_path or "analysis/project.py", 1,
+                "metrics catalog (METRICS literal dict) missing or "
+                "unparseable",
+            ))
+        return findings
+
+    for name, group in sorted(by_name.items()):
+        s = group[0]
+        entry = catalog.get(name)
+        if entry is None:
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"family {name!r} is not declared in the METRICS catalog "
+                f"({project.catalog_path})",
+            ))
+            continue
+        cat_kind, cat_labels = entry[0], tuple(entry[1])
+        if cat_kind != s.kind:
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"family {name!r} registered as {s.kind} but cataloged "
+                f"as {cat_kind}",
+            ))
+        if s.labels is not None and tuple(s.labels) != cat_labels:
+            findings.append(Finding(
+                RULE_ID, s.sf.rel, s.line,
+                f"family {name!r} registered with labels "
+                f"{tuple(s.labels)} but cataloged with {cat_labels}",
+            ))
+    for name in sorted(set(catalog) - set(by_name)):
+        findings.append(Finding(
+            RULE_ID, catalog_sf.rel, 1,
+            f"METRICS catalog entry {name!r} is registered nowhere — "
+            "remove it or restore the family",
+        ))
+
+    if project.observability_doc:
+        doc = project.read_doc(project.observability_doc)
+        if doc is None:
+            findings.append(Finding(
+                RULE_ID, catalog_sf.rel, 1,
+                f"cross-check doc {project.observability_doc} not found",
+            ))
+        else:
+            for name in sorted(catalog):
+                if name not in doc:
+                    findings.append(Finding(
+                        RULE_ID, catalog_sf.rel, 1,
+                        f"cataloged family {name!r} is undocumented in "
+                        f"{project.observability_doc}",
+                    ))
+    return findings

@@ -1,0 +1,60 @@
+"""Rule ``monotonic-clock`` (R5): duration and deadline math never reads
+the wall clock.
+
+``time.time()`` jumps — NTP slew, leap smearing, a VM migration — and a
+jump inside duration arithmetic becomes a negative stage time, a deadline
+that never fires, or a watchdog that fires instantly (for a clinical
+predictor, a correctness bug, not a style nit). The repo's convention
+(CHANGES.md PR 2/6): ``time.perf_counter()`` for measured durations,
+``time.monotonic()`` for deadlines/uptime, wall clock ONLY for
+human/manifest timestamps.
+
+Statically: every call to ``time.time()``, ``datetime.now()``,
+``datetime.utcnow()`` (including ``datetime.datetime.…``) in the scanned
+tree is a finding. Sites that genuinely want a wall-clock *timestamp*
+(the journal's ISO-8601 stamps, manifest fields, epoch anchors for trace
+export) opt out per line::
+
+    "started": time.time(),  # graftcheck: disable=monotonic-clock
+
+which is exactly the reviewable artifact we want: every wall-clock read
+in the codebase is either duration-safe or visibly declared a timestamp.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Project, dotted
+
+RULE_ID = "monotonic-clock"
+
+_WALL_CALLS = {
+    "time.time": "time.time() in code that may feed duration/deadline "
+    "math; use time.perf_counter()/time.monotonic(), or mark the line "
+    "as a timestamp",
+    "datetime.now": "datetime.now() is wall-clock; use "
+    "time.monotonic() for deadlines or mark the line as a timestamp",
+    "datetime.utcnow": "datetime.utcnow() is wall-clock; use "
+    "time.monotonic() for deadlines or mark the line as a timestamp",
+    "datetime.datetime.now": "datetime.now() is wall-clock; use "
+    "time.monotonic() for deadlines or mark the line as a timestamp",
+    "datetime.datetime.utcnow": "datetime.utcnow() is wall-clock; use "
+    "time.monotonic() for deadlines or mark the line as a timestamp",
+}
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if chain in _WALL_CALLS:
+                findings.append(Finding(
+                    RULE_ID, sf.rel, node.lineno, _WALL_CALLS[chain]
+                ))
+    return findings

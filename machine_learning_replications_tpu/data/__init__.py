@@ -3,28 +3,29 @@
 Host-side ingest (``.mat`` files, synthetic cohorts) producing arrays that are
 then placed onto the TPU mesh as sharded DeviceArrays (see ``sharding.py``).
 Reference contract: ``HF/load_data_public.py:4-14``.
+
+Re-exports resolve lazily (PEP 562): ``sharding`` imports jax at module
+level, and importing any submodule of this package executes this
+``__init__`` — an eager re-export here put jax into the import-time
+closure of every consumer of ``data.examples``/``data.schema``, including
+the declared-jax-free ``score.reader`` parse path (graftcheck rule
+``import-purity``; the jax-free manifest lives in ``analysis/project.py``).
 """
 
-from machine_learning_replications_tpu.data.matloader import load_data, save_data
-from machine_learning_replications_tpu.data.schema import (
-    COHORT_SCHEMA,
-    N_COHORT,
-    SELECTED_17,
-    selected_indices,
-    variable_names,
-)
-from machine_learning_replications_tpu.data.synthetic import make_cohort
-from machine_learning_replications_tpu.data.sharding import shard_rows, pad_rows
+from machine_learning_replications_tpu.lazyimport import lazy_exports
 
-__all__ = [
-    "load_data",
-    "save_data",
-    "make_cohort",
-    "shard_rows",
-    "pad_rows",
-    "COHORT_SCHEMA",
-    "N_COHORT",
-    "SELECTED_17",
-    "selected_indices",
-    "variable_names",
-]
+_EXPORTS = {
+    "load_data": "matloader",
+    "save_data": "matloader",
+    "COHORT_SCHEMA": "schema",
+    "N_COHORT": "schema",
+    "SELECTED_17": "schema",
+    "selected_indices": "schema",
+    "variable_names": "schema",
+    "make_cohort": "synthetic",
+    "shard_rows": "sharding",
+    "pad_rows": "sharding",
+}
+
+__all__ = sorted(_EXPORTS)
+__getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)

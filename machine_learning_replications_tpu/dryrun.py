@@ -63,7 +63,7 @@ def force_cpu_platform(n_devices: int) -> None:
 def run(n_devices: int) -> None:
     """The dry run proper. Assumes the backend is already pointed at ≥
     ``n_devices`` devices (see ``force_cpu_platform`` / the driver env)."""
-    t_all = time.time()
+    t_all = time.perf_counter()
     _say(f"phase 0: importing jax (n_devices={n_devices})")
     import jax
     import jax.numpy as jnp
@@ -71,7 +71,7 @@ def run(n_devices: int) -> None:
 
     avail = len(jax.devices())
     _say(f"phase 0 done: backend={jax.default_backend()} devices={avail} "
-         f"({time.time() - t_all:.1f}s)")
+         f"({time.perf_counter() - t_all:.1f}s)")
     if avail < n_devices:
         raise RuntimeError(
             f"need {n_devices} devices, backend has {avail}; set "
@@ -89,18 +89,18 @@ def run(n_devices: int) -> None:
         stump_trainer,
     )
 
-    t = time.time()
+    t = time.perf_counter()
     model = 2 if n_devices % 2 == 0 and n_devices >= 2 else 1
     mesh = make_mesh(data=n_devices // model, model=model)
     X, y, _ = make_cohort(n=96, seed=3)
     Xs = X[:, selected_indices()]
     _say(f"phase 1 done: mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}, "
-         f"cohort 96x17 ({time.time() - t:.1f}s)")
+         f"cohort 96x17 ({time.perf_counter() - t:.1f}s)")
 
     # Phase 2 — full sharded depth-1 training step (all boosting stages):
     # rows over 'data' (histogram partials psum over ICI), feature tiles
     # over 'model' (split search all_gather); parity vs single-device.
-    t = time.time()
+    t = time.perf_counter()
     cfg = GBDTConfig(n_estimators=4, max_depth=1)
     sharded, _ = stump_trainer.fit(mesh, Xs, y, cfg)
     single, _ = gbdt.fit(Xs, y, cfg)
@@ -111,13 +111,13 @@ def run(n_devices: int) -> None:
         np.asarray(sharded.value), np.asarray(single.value), rtol=1e-5, atol=1e-6
     )
     _say(f"phase 2 done: 4 sharded stump stages == single-device "
-         f"({time.time() - t:.1f}s)")
+         f"({time.perf_counter() - t:.1f}s)")
 
     # Phase 3 — level-wise trainer, depth 2: per-level histogram psums,
     # replicated split selection. Parity at the model level (deviance +
     # predictions) — psum reduction order may flip near-tied split argmaxes
     # between equivalent trees (cf. tests/test_hist_trainer.py).
-    t = time.time()
+    t = time.perf_counter()
     cfg2 = GBDTConfig(n_estimators=3, max_depth=2, splitter="hist", n_bins=16)
     sh2, aux_sh2 = hist_trainer.fit(mesh, Xs, y, cfg2)
     sd2, aux_sd2 = gbdt.fit(Xs, y, cfg2)
@@ -130,12 +130,12 @@ def run(n_devices: int) -> None:
         rtol=1e-5, atol=1e-6,
     )
     _say(f"phase 3 done: 3 depth-2 level-wise stages parity-checked "
-         f"({time.time() - t:.1f}s)")
+         f"({time.perf_counter() - t:.1f}s)")
 
     # Phase 4 — sharded inference + data-parallel meta Newton step under jit
     # with NamedSharding-constrained inputs (GSPMD inserts the collectives).
     # Padding rows fabricated by shard_rows are masked per its contract.
-    t = time.time()
+    t = time.perf_counter()
     (Xd, yd), n_rows = shard_rows(mesh, Xs.astype(np.float32), y.astype(np.float32))
     row_mask = (np.arange(Xd.shape[0]) < n_rows).astype(np.float32)
 
@@ -149,13 +149,13 @@ def run(n_devices: int) -> None:
     m, coef = eval_step(sharded, Xd, yd, row_mask)
     assert np.isfinite(float(m)) and np.isfinite(np.asarray(coef)).all()
     _say(f"phase 4 done: sharded eval + meta Newton step, mean p1 = "
-         f"{float(m):.4f} ({time.time() - t:.1f}s)")
+         f"{float(m):.4f} ({time.perf_counter() - t:.1f}s)")
 
     # Phase 5 — sharded stacking members (VERDICT r2 item 8): a masked SVC
     # fold fit and the L1-LR FISTA fit under jit with row-sharded inputs
     # (GSPMD inserts the collectives for the kernel matrix and the matvecs);
     # parity vs the same fits on unsharded arrays.
-    t = time.time()
+    t = time.perf_counter()
     from jax.sharding import NamedSharding, PartitionSpec as P
     from machine_learning_replications_tpu.models import scaler, svm
     from machine_learning_replications_tpu.parallel.mesh import DATA_AXIS
@@ -191,12 +191,12 @@ def run(n_devices: int) -> None:
     np.testing.assert_allclose(np.asarray(c_sh), np.asarray(c_sd),
                                rtol=1e-3, atol=1e-5)
     _say(f"phase 5 done: sharded masked SVC + L1-LR fits == single-device "
-         f"({time.time() - t:.1f}s)")
+         f"({time.perf_counter() - t:.1f}s)")
 
     # Phase 6 — the mesh-routed pipeline stages: row-sharded imputer
     # transform and the stacking CV's GBDT fold fits through the sharded
     # trainer, each against its single-device counterpart.
-    t = time.time()
+    t = time.perf_counter()
     from machine_learning_replications_tpu.config import ExperimentConfig, SVCConfig
     from machine_learning_replications_tpu.models import knn_impute, pipeline
 
@@ -211,13 +211,13 @@ def run(n_devices: int) -> None:
     meta_sd = pipeline.cross_val_member_probas(Xs, y, ecfg)
     np.testing.assert_allclose(meta_sh[:, 1], meta_sd[:, 1], rtol=1e-5, atol=1e-6)
     _say(f"phase 6 done: sharded imputer transform + mesh CV fold fits == "
-         f"single-device ({time.time() - t:.1f}s)")
+         f"single-device ({time.perf_counter() - t:.1f}s)")
 
     # Phase 7 — sharded feature selection: the covariance-form LassoCV's
     # per-fold Gram statistics psum'd over 'data'
     # (parallel.select_trainer), against the static-slice single-device
     # stats; the full selection (top-17 mask) must agree exactly.
-    t = time.time()
+    t = time.perf_counter()
     from machine_learning_replications_tpu.config import LassoSelectConfig
     from machine_learning_replications_tpu.models import feature_selection
 
@@ -227,7 +227,7 @@ def run(n_devices: int) -> None:
     np.testing.assert_array_equal(mask_sh, mask_sd)
     assert int(mask_sh.sum()) == sel_cfg.max_features
     _say(f"phase 7 done: sharded lasso fold-Gram selection == single-device "
-         f"({time.time() - t:.1f}s)")
+         f"({time.perf_counter() - t:.1f}s)")
 
     # Phase 8 — the CV grid sweep (BASELINE config 4) row-sharded: each
     # (depth, fold) fit through fit_gbdt_sharded with the fold mask on the
@@ -236,7 +236,7 @@ def run(n_devices: int) -> None:
     # stump / hist) and vmapped (level-wise) trainers may break EQUAL-GAIN
     # split ties differently — both sklearn-legal — and the tiny
     # mostly-binary cohort above is tie-dense.
-    t = time.time()
+    t = time.perf_counter()
     from machine_learning_replications_tpu.config import SweepConfig
     from machine_learning_replications_tpu.models import sweep as sweep_mod
 
@@ -252,7 +252,7 @@ def run(n_devices: int) -> None:
         sw_sh.fold_auc, sw_sd.fold_auc, rtol=0, atol=1e-9
     )
     _say(f"phase 8 done: mesh grid sweep AUC surface == single-device "
-         f"({time.time() - t:.1f}s)")
+         f"({time.perf_counter() - t:.1f}s)")
 
     # Phase 9 — the COMPOSED program (VERDICT r4 weak #6): fit_pipeline
     # end-to-end on the mesh — impute → select → stack — then a sharded
@@ -260,7 +260,7 @@ def run(n_devices: int) -> None:
     # Phases 2-8 validate each stage's sharding in isolation; only a
     # composed run can catch stage-BOUNDARY mismatches (e.g. the selected-
     # column subset of a row-sharded imputed array feeding the stacked fit).
-    t = time.time()
+    t = time.perf_counter()
     X9, y9, _ = make_cohort(n=128, seed=7, missing_rate=0.05)
     pp_sh, info_sh = pipeline.fit_pipeline(X9, y9, ecfg, mesh=mesh)
     pp_sd, info_sd = pipeline.fit_pipeline(X9, y9, ecfg)
@@ -275,9 +275,9 @@ def run(n_devices: int) -> None:
     # drift envelope as phase 5's member fits.
     np.testing.assert_allclose(pq_sh, pq_sd, rtol=1e-3, atol=1e-5)
     _say(f"phase 9 done: composed fit_pipeline + batch predict on the mesh "
-         f"== single-device ({time.time() - t:.1f}s)")
+         f"== single-device ({time.perf_counter() - t:.1f}s)")
 
-    _say(f"dryrun_multichip OK in {time.time() - t_all:.1f}s: mesh "
+    _say(f"dryrun_multichip OK in {time.perf_counter() - t_all:.1f}s: mesh "
          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, all phases "
          "parity-checked")
 

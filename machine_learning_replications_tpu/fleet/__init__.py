@@ -24,7 +24,10 @@ fleet tier is the layer that composes them into a *service*:
     scale decisions (``cli fleet autoscale``).
 
 Deliberately jax-free: a router process starts in milliseconds and
-needs no accelerator stack.
+needs no accelerator stack. Enforced statically — the whole package is
+in the import-purity manifest (``analysis/project.py``; graftcheck rule
+``import-purity``, docs/ANALYSIS.md), so an import-time jax edge
+anywhere in its transitive closure fails CI.
 """
 
 from machine_learning_replications_tpu.fleet.autoscale import (

@@ -118,7 +118,9 @@ class Replica:
         self.probe_fails = 0
         self.probe_oks = 0
         self.request_fails = 0
-        self.registered_at = time.time()
+        # Wall-clock by intent: these are display timestamps in the
+        # /fleet/replicas payload, never duration operands.
+        self.registered_at = time.time()  # graftcheck: disable=monotonic-clock
         self.last_probe_at: float | None = None
         self.last_change_at = self.registered_at
         # Load signals driving least-loaded picking (module docstring).
@@ -436,7 +438,7 @@ class ReplicaRegistry:
             rep = self._replicas.get(replica_id)
             if rep is None:
                 return
-            rep.last_probe_at = time.time()
+            rep.last_probe_at = time.time()  # graftcheck: disable=monotonic-clock
             if ok and version is not None:
                 rep.version = version
             if ok and queue_depth is not None:
@@ -486,7 +488,7 @@ class ReplicaRegistry:
         was_in = rep.state == READY and not rep.held
         rep.state = state
         rep.reason = reason
-        rep.last_change_at = time.time()
+        rep.last_change_at = time.time()  # graftcheck: disable=monotonic-clock
         if state == OUT:
             # Recovery hysteresis starts from zero at the moment of the
             # outage: ok-probes accumulated while READY must not let a

@@ -62,8 +62,12 @@ process registry, strict-exposition clean), and ``/debug/requests``
 (the router's own flight-recorded traces: route → upstream → respond
 phase attribution per sampled request).
 
-No jax imports anywhere on this path — the router starts in
+No jax imports anywhere on this path (graftcheck rule
+``import-purity`` proves it transitively in CI) — the router starts in
 milliseconds and runs fine on a host with no accelerator stack at all.
+The one-loop-thread socket-ownership contract is annotated with
+``@loop_only`` / ``@cross_thread`` (``contracts.py``) and enforced by
+rule ``loop-discipline``.
 """
 
 from __future__ import annotations
@@ -83,6 +87,10 @@ from machine_learning_replications_tpu.serve.metrics import LATENCY_BUCKETS_S
 from machine_learning_replications_tpu.serve.transport import (
     EventLoopHttpServer,
     UpstreamPool,
+)
+from machine_learning_replications_tpu.contracts import (
+    cross_thread,
+    loop_only,
 )
 
 FLEET_REQUESTS = REGISTRY.counter(
@@ -194,6 +202,7 @@ class _CaptureFeed:
         )
         self._thread.start()
 
+    @loop_only
     def append(self, body: bytes) -> None:
         try:
             self._q.put_nowait(body)
@@ -254,6 +263,7 @@ class _ProxyJob:
         self.pending: list = []  # in-flight UpstreamAttempts
         self.done = False
 
+    @loop_only
     def _claim(self) -> bool:
         if self.done:
             return False
@@ -261,6 +271,7 @@ class _ProxyJob:
         self._settle()
         return True
 
+    @loop_only
     def _settle(self) -> None:
         """Terminal cleanup: stop the timers and cancel the losing
         in-flight attempts (their connections close — a reply may be
@@ -277,6 +288,7 @@ class _ProxyJob:
 
     # -- admission / dispatch (loop thread) ----------------------------------
 
+    @loop_only
     def start(self) -> None:
         rep = self.app.registry.pick()
         if rep is None:
@@ -291,6 +303,7 @@ class _ProxyJob:
             )
         self.dispatch(rep)
 
+    @loop_only
     def finish_no_replica(self) -> None:
         if not self._claim():
             return
@@ -300,6 +313,7 @@ class _ProxyJob:
             headers={"Retry-After": "1"},
         )
 
+    @loop_only
     def dispatch(self, rep: dict) -> None:
         if self.done:
             return
@@ -309,6 +323,7 @@ class _ProxyJob:
         self.tried.add(rep["id"])
         self._send(rep)
 
+    @loop_only
     def _send(self, rep: dict) -> None:
         """Fire one upstream attempt through the loop-owned pool."""
         remaining = self.deadline_mono - time.monotonic()
@@ -341,6 +356,7 @@ class _ProxyJob:
         cell.append(att)
         self.pending.append(att)
 
+    @loop_only
     def retry(self, reason: str, failed: dict) -> bool:
         """Pick another replica and re-send; False when the retry budget
         (attempts, candidates, deadline) is exhausted."""
@@ -358,6 +374,7 @@ class _ProxyJob:
 
     # -- timers (loop thread) ------------------------------------------------
 
+    @loop_only
     def on_deadline(self) -> None:
         if not self._claim():
             return
@@ -369,6 +386,7 @@ class _ProxyJob:
             }).encode(),
         )
 
+    @loop_only
     def on_hedge(self) -> None:
         """Hedge delay expired with no reply: fire a duplicate against a
         replica not yet tried (if one is in rotation). ``pick`` falls
@@ -394,6 +412,7 @@ class _ProxyJob:
 
     # -- the upstream completion (loop thread) --------------------------------
 
+    @loop_only
     def on_upstream(self, rep: dict, t0: float, att, result) -> None:
         """One attempt resolved: ``result`` is a ``protocol.
         HttpResponse`` or an ``UpstreamError``. The replica's load
@@ -495,6 +514,7 @@ class _ProxyJob:
                 upstream_headers=up_headers, replica=rid,
             )
 
+    @loop_only
     def _try_backoff_retry(self, rep: dict) -> bool:
         """Everything in rotation already shed this request: honor the
         upstream ``Retry-After`` (bounded by the remaining budget) and
@@ -544,7 +564,9 @@ class _RouterApp:
         self.httpd = None
         self.upstream: UpstreamPool | None = None
         self._addrs: dict[str, tuple[str, int]] = {}
-        self.started_at = time.time()
+        # Monotonic: feeds /healthz uptime_seconds, which is duration
+        # arithmetic (rule monotonic-clock).
+        self.started_monotonic = time.monotonic()
 
     def replica_addr(self, url: str) -> tuple[str, int]:
         """Replica url → (host, port), cached — one urlparse per replica
@@ -558,6 +580,7 @@ class _RouterApp:
 
     # -- transport interface -------------------------------------------------
 
+    @loop_only
     def handle_request(self, req, rsp) -> None:
         if not self.quiet:
             import sys
@@ -583,11 +606,13 @@ class _RouterApp:
                 close=True,
             )
 
+    @loop_only
     def handle_protocol_error(self, exc, rsp) -> None:
         rsp.send_json(exc.code, {"error": exc.message}, close=True)
 
     # -- data path -----------------------------------------------------------
 
+    @loop_only
     def _predict(self, req, rsp) -> None:
         trace = reqtrace.RequestTrace(
             reqtrace.sanitize_request_id(req.get_header("x-request-id"))
@@ -606,6 +631,7 @@ class _RouterApp:
         job = _ProxyJob(self, trace, rsp, req.body, pin, deadline_s)
         job.start()
 
+    @loop_only
     def finish(
         self, job: _ProxyJob, outcome: str, code: int, body: bytes,
         upstream_headers: dict[str, str] | None = None,
@@ -652,6 +678,7 @@ class _RouterApp:
 
     # -- control plane --------------------------------------------------------
 
+    @loop_only
     def _get(self, req, rsp) -> None:
         path = req.path
         if path == "/healthz":
@@ -678,7 +705,9 @@ class _RouterApp:
                     self.upstream.stats()
                     if self.upstream is not None else None
                 ),
-                "uptime_seconds": round(time.time() - self.started_at, 3),
+                "uptime_seconds": round(
+                    time.monotonic() - self.started_monotonic, 3
+                ),
             })
         elif path == "/readyz":
             ready = self.registry.ready_count()
@@ -718,6 +747,7 @@ class _RouterApp:
         else:
             rsp.send_json(404, {"error": f"no such path: {path}"})
 
+    @loop_only
     def _post_replicas(self, req, rsp) -> None:
         """Registration endpoint (``cli serve --register`` posts here):
         ``{"id", "url"}`` adds a replica, ``{"deregister": id}`` removes
@@ -760,6 +790,7 @@ class _RouterApp:
             str(rid), str(url)
         )})
 
+    @loop_only
     def _post_deploy(self, req, rsp) -> None:
         """Start a rolling deploy (``fleet.deploy.rolling_deploy``) over
         every registered replica; replies when the rollout is DONE.
@@ -836,6 +867,7 @@ class RouterHandle:
         self._deploy_lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
+    @cross_thread
     def _set_deploy_status(self, status: dict) -> None:
         self.deploy_status = status
 
@@ -854,6 +886,7 @@ class RouterHandle:
         self._thread.start()
         return self
 
+    @cross_thread
     def shutdown(self) -> None:
         self.prober.close()
         self.httpd.shutdown()

@@ -23,7 +23,8 @@ once, at refit time: ``load_recent`` routes the captured lines through
 contract bulk scoring uses — so a malformed line captured from a hostile
 client costs the refit one dropped row, not a crash.
 
-jax-free by construction: the router process imports this module.
+jax-free by construction (rule ``import-purity`` via the fleet
+manifest): the router process imports this module.
 """
 
 from __future__ import annotations

@@ -56,14 +56,14 @@ def run_cycle(
         if say is not None:
             say(msg)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     X17, n_bad = capturemod.load_recent(capture_dir, max_rows=max_rows)
     _say(f"captured cohort: {X17.shape[0]} rows ({n_bad} malformed dropped)")
     if X17.shape[0] < min_rows:
         journal.event(
             "learn_cycle_done", outcome="skipped",
             reason=f"only {X17.shape[0]} captured rows (min {min_rows})",
-            seconds=round(time.time() - t0, 3),
+            seconds=round(time.perf_counter() - t0, 3),
         )
         return {
             "outcome": "skipped",
@@ -119,7 +119,7 @@ def run_cycle(
             "verdict": verdict,
             "promotion": result,
         }
-    summary["seconds"] = round(time.time() - t0, 3)
+    summary["seconds"] = round(time.perf_counter() - t0, 3)
     # The arc's destination version: the LIVE path's id after a
     # promotion republishes the candidate (the candidate dir keeps its
     # own local counter — journaling that would tell a v1→v1 story).

@@ -143,7 +143,7 @@ def warm_refit(
             "the members — provide labels or widen the capture window"
         )
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     journal.event(
         "learn_retrain_start", rows=n, labels_source=labels_source,
         family=type(live_params).__name__, out=os.fspath(out_dir),
@@ -187,10 +187,10 @@ def warm_refit(
         journal.event(
             "learn_retrain_failed", rows=n,
             error=f"{type(exc).__name__}: {exc}",
-            seconds=round(time.time() - t0, 3),
+            seconds=round(time.perf_counter() - t0, 3),
         )
         raise
-    seconds = round(time.time() - t0, 3)
+    seconds = round(time.perf_counter() - t0, 3)
     version = orbax_io.checkpoint_version(out_dir)
     RETRAINS.inc(result="ok")
     RETRAIN_SECONDS.get().set(seconds)

@@ -26,7 +26,8 @@ fired or suppressed, with the suppressing rule and the offending
 features — so the journal answers "why did/didn't the loop act at t?"
 without reconstruction.
 
-jax-free: the trigger is an HTTP poller plus a tiny state machine; it
+jax-free (enforced: graftcheck rule ``import-purity``): the trigger
+is an HTTP poller plus a tiny state machine; it
 runs happily inside the router process or the ``cli learn run`` daemon.
 """
 

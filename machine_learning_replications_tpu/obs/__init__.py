@@ -43,9 +43,12 @@ layer every other layer reports into:
                 families, the serving ``/debug/quality`` endpoint, and
                 journaled ``ok``/``warn``/``alert`` status transitions.
 
-Importing this package (or ``journal``/``registry``) never imports jax:
-``bench.py``'s orchestrator — which must not touch the flaky TPU plugin —
-builds its run manifest through ``obs.journal`` too.
+Importing this package (or ``journal``/``registry``) never imports jax
+(graftcheck rule ``import-purity``): ``bench.py``'s orchestrator — which
+must not touch the flaky TPU plugin — builds its run manifest through
+``obs.journal`` too. Metric-family and journal-event names are closed
+catalogs (``obs.catalog``; rules ``metrics-catalog`` /
+``journal-catalog``, docs/ANALYSIS.md).
 """
 
 from machine_learning_replications_tpu.obs import (  # noqa: F401

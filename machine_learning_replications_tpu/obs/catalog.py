@@ -1,0 +1,209 @@
+"""The closed catalogs of metric families and journal events.
+
+Two registries of *names* used to live scattered across the codebase as
+string literals: every ``REGISTRY.counter/gauge/histogram`` family name,
+and every ``journal.event`` kind. Both are now declared here, in one
+pure-literal module, and enforced statically by graftcheck
+(``metrics-catalog`` / ``journal-catalog`` — docs/ANALYSIS.md):
+
+  * a family registered in code but absent here fails CI (and vice
+    versa: a catalog entry nothing registers is dead weight and fails
+    too);
+  * an event emitted under a name not in ``EVENTS``, or missing one of
+    its required keys, fails CI — a dashboard or drill that greps the
+    journal for ``fleet_rotation`` can trust the name exists and carries
+    ``replica``/``direction``/``reason``.
+
+graftcheck reads this file with ``ast.literal_eval`` — never imports it —
+so BOTH dicts must stay literal (no comprehensions, no f-strings, no
+calls). docs/OBSERVABILITY.md's family table is cross-checked against
+``METRICS`` by the same rule.
+
+The serving layer's fixed ``serve_*`` instruments (``serve/metrics.py``)
+predate labeled families and render through their own exposition path;
+they are outside ``METRICS`` by design (see docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+#: Every process-global metric family: name -> (kind, label names).
+#: Kind is "counter" | "gauge" | "histogram".
+METRICS: dict[str, tuple[str, tuple[str, ...]]] = {
+    # -- serve/ --------------------------------------------------------------
+    "serve_path_total": ("counter", ("path",)),
+    "serve_host_fallback_total": ("counter", ()),
+    "serve_deploys_total": ("counter", ("result",)),
+    "serve_model_version": ("gauge", ()),
+    "serve_worker_info": ("gauge", ("worker",)),
+    # -- obs/ ----------------------------------------------------------------
+    "profile_captures_total": ("counter", ("outcome",)),
+    "quality_feature_psi": ("gauge", ("feature",)),
+    "quality_feature_ks": ("gauge", ("feature",)),
+    "quality_score_psi": ("gauge", ()),
+    "quality_member_disagreement": ("gauge", ()),
+    "quality_window_rows": ("gauge", ()),
+    "quality_status": ("gauge", ()),
+    "quality_rows_total": ("counter", ()),
+    "quality_status_transitions_total": ("counter", ("to",)),
+    "quality_feed_dropped_rows_total": ("counter", ("reason",)),
+    "quality_feed_depth": ("gauge", ()),
+    "reqtrace_sampled_total": ("counter", ("reason",)),
+    "reqtrace_dropped_total": ("counter", ()),
+    "jax_compiles_total": ("counter", ()),
+    "jax_compile_seconds_total": ("counter", ()),
+    "jax_trace_seconds_total": ("counter", ()),
+    "jax_compilation_cache_events_total": ("counter", ("event",)),
+    "jax_transfer_bytes_total": ("counter", ("direction",)),
+    "slo_requests_total": ("counter", ("slo",)),
+    "slo_bad_total": ("counter", ("slo",)),
+    "slo_good_ratio": ("gauge", ("slo",)),
+    "slo_burn_rate": ("gauge", ("slo",)),
+    "slo_error_budget_remaining_ratio": ("gauge", ("slo",)),
+    "slo_target_ratio": ("gauge", ("slo",)),
+    # -- resilience/ ---------------------------------------------------------
+    "fault_injected_total": ("counter", ("site",)),
+    "resilience_checkpoint_rollbacks_total": ("counter", ()),
+    "resilience_breaker_state": ("gauge", ()),
+    "resilience_breaker_transitions_total": ("counter", ("to",)),
+    "resilience_engine_restarts_total": ("counter", ("result",)),
+    "resilience_watchdog_trips_total": ("counter", ()),
+    "resilience_degraded_sheds_total": ("counter", ()),
+    # -- fleet/ --------------------------------------------------------------
+    "fleet_replicas": ("gauge", ("state",)),
+    "fleet_rotations_total": ("counter", ("direction",)),
+    "fleet_probe_total": ("counter", ("result",)),
+    "fleet_requests_total": ("counter", ("outcome",)),
+    "fleet_upstream_attempts_total": ("counter", ("result",)),
+    "fleet_retries_total": ("counter", ("reason",)),
+    "fleet_hedges_total": ("counter", ()),
+    "fleet_hedge_wins_total": ("counter", ()),
+    "fleet_replica_requests_total": ("counter", ("replica", "result")),
+    "fleet_request_latency_seconds": ("histogram", ()),
+    "fleet_deploys_total": ("counter", ("result",)),
+    "fleet_upstream_connections_total": ("counter", ("event",)),
+    "fleet_capture_dropped_total": ("counter", ()),
+    "lifecycle_transitions_total": ("counter", ("event",)),
+    "lifecycle_replicas": ("gauge", ("state",)),
+    "autoscale_decisions_total": ("counter", ("decision",)),
+    "autoscale_signal": ("gauge", ("signal",)),
+    "autoscale_streak": ("gauge", ("kind",)),
+    "autoscale_desired_replicas": ("gauge", ()),
+    # -- learn/ --------------------------------------------------------------
+    "learn_capture_rows_total": ("counter", ()),
+    "learn_capture_retained_rows": ("gauge", ()),
+    "learn_trigger_total": ("counter", ("outcome",)),
+    "learn_trigger_alert_streak": ("gauge", ()),
+    "learn_retrain_total": ("counter", ("result",)),
+    "learn_retrain_seconds": ("gauge", ()),
+    "learn_shadow_divergence_mean": ("gauge", ()),
+    "learn_shadow_divergence_p95": ("gauge", ()),
+    "learn_shadow_divergence_max": ("gauge", ()),
+    "learn_shadow_flip_rate": ("gauge", ()),
+    "learn_shadow_score_psi": ("gauge", ()),
+    "learn_shadow_candidate_worst_psi": ("gauge", ()),
+    "learn_shadow_candidate_status": ("gauge", ()),
+    "learn_shadow_disagreement_delta": ("gauge", ()),
+    "learn_shadow_rows": ("gauge", ()),
+    "learn_shadow_evaluations_total": ("counter", ("verdict",)),
+    "learn_promotions_total": ("counter", ("result",)),
+    # -- score/ --------------------------------------------------------------
+    "score_rows_total": ("counter", ()),
+    "score_quarantined_rows_total": ("counter", ()),
+    "score_chunks_total": ("counter", ()),
+    "score_chunk_seconds": ("histogram", ()),
+    "score_queue_depth": ("gauge", ("stage",)),
+    "score_stage_seconds_total": ("counter", ("stage",)),
+}
+
+#: Every journal event kind -> the keys EVERY emit site must carry.
+#: (Sites may add more; ``**extra`` spreads satisfy any requirement at
+#: the spread site but graftcheck still requires the kind to be listed.)
+#: The run manifest record (kind="manifest") is written directly by
+#: ``RunJournal.__init__``, not through ``event``, and is not an entry.
+EVENTS: dict[str, tuple[str, ...]] = {
+    # -- run lifecycle (cli, journal) ---------------------------------------
+    "run_done": (),
+    "run_error": ("error",),
+    "stage_start": ("stage",),
+    "stage_done": ("stage", "seconds", "checkpointed"),
+    "stage_error": ("stage", "seconds", "error"),
+    # -- serving (serve/) ----------------------------------------------------
+    "flush": ("seq", "rows", "ok"),
+    "deploy_start": ("path", "from_version", "replica"),
+    "deploy_applied": (
+        "path", "from_version", "to_version", "replica", "seconds",
+    ),
+    "deploy_failed": ("path", "error", "replica", "seconds"),
+    "deploy_quality_detached": ("path",),
+    # -- checkpoints (persist/) ---------------------------------------------
+    "checkpoint_publish": ("path", "version"),
+    "checkpoint_restore": ("stage",),
+    "checkpoint_corrupt": ("stage", "error"),
+    "checkpoint_retain_skipped": ("path", "error"),
+    "checkpoint_rollback": ("path", "lastgood", "error"),
+    # -- resilience/ ---------------------------------------------------------
+    "fault_armed": ("site", "spec"),
+    "fault_disarmed": ("site",),
+    "fault_injected": ("site", "mode", "fire", "spec"),
+    "faults_reset": ("sites",),
+    "breaker_open": ("reason", "wedged"),
+    "breaker_close": ("attempts", "open_seconds"),
+    "engine_restart": ("attempt", "ok", "seconds"),
+    "engine_swap": ("warm",),
+    # -- observability (obs/) ------------------------------------------------
+    "profile_capture": ("ok", "seconds"),
+    "quality_status": (
+        "from_status", "to_status", "window_rows", "worst_feature",
+        "worst_psi", "score_psi",
+    ),
+    "quality_rebased": ("reference_rows", "feature_bins"),
+    "quality_profile_missing": ("path",),
+    "quality_feed_disabled": ("error",),
+    "quality_feed_reenabled": ("after",),
+    # -- fleet/ --------------------------------------------------------------
+    "fleet_router_started": ("address", "replicas"),
+    "fleet_replica_registered": ("replica", "url"),
+    "fleet_replica_deregistered": ("replica", "url"),
+    "fleet_rotation": ("replica", "direction", "reason"),
+    "fleet_deploy_start": (
+        "model", "target_version", "replicas", "concurrency",
+    ),
+    "fleet_deploy_replica": ("model",),
+    "fleet_deploy_done": (
+        "model", "target_version", "result", "error", "seconds",
+    ),
+    "replica_registered": ("replica", "router", "url"),
+    "lifecycle_spawn": ("replica", "pid", "port", "attempt", "respawn"),
+    "lifecycle_spawn_failed": (
+        "replica", "reason", "attempts", "retry_in_s",
+    ),
+    "lifecycle_ready": ("replica", "url", "seconds", "respawn"),
+    "lifecycle_crash": ("replica", "state", "detail"),
+    "lifecycle_drain": ("replica", "reason", "settle_deadline_s"),
+    "lifecycle_drain_error": ("replica", "error"),
+    "lifecycle_term": (
+        "replica", "delivered", "drained", "kill_deadline_s",
+    ),
+    "lifecycle_kill": ("replica", "reason"),
+    "lifecycle_exit": ("replica", "code", "reason"),
+    "autoscale_decision": ("decision", "reason", "ready", "desired"),
+    "autoscale_tick_error": ("error",),
+    # -- learn/ --------------------------------------------------------------
+    "learn_trigger": ("fired", "reason"),
+    "learn_settle": ("skipped",),
+    "learn_retrain_start": ("family", "rows", "labels_source", "out"),
+    "learn_retrain_done": (),
+    "learn_retrain_failed": ("error", "rows", "seconds"),
+    "learn_shadow_verdict": ("passed", "reasons"),
+    "learn_promotion": ("candidate", "result"),
+    "learn_candidate_published": ("candidate", "model", "version"),
+    "learn_cycle_done": ("outcome",),
+    "learn_recovery": ("recovered",),
+    # -- score/ --------------------------------------------------------------
+    "score_resume": ("chunks", "rows", "bad_rows", "lines"),
+    "score_chunk": ("seq", "rows", "bad", "seconds"),
+    "score_done": (
+        "rows", "bad_rows", "chunks", "wall_seconds", "rows_per_second",
+        "output_sha256",
+    ),
+}

@@ -13,7 +13,9 @@ fixes both:
     embeds the same manifest) names exactly what produced it.
     ``run_manifest`` builds the dict without importing jax (versions come
     from ``importlib.metadata``): ``bench.py``'s orchestrator, which must
-    never touch the TPU plugin, calls it too.
+    never touch the TPU plugin, calls it too (enforced: graftcheck rule
+    ``import-purity``; event names and required keys live in the
+    ``obs.catalog`` EVENTS catalog, rule ``journal-catalog``).
   * **Structured events after.** One JSON object per line, ``ts`` in
     ISO-8601 UTC (the r4 lesson behind ``stage_say``'s timestamp fix: a
     multi-hour log with time-of-day-only local stamps is ambiguous across
@@ -53,7 +55,9 @@ from machine_learning_replications_tpu.obs import spans
 
 def utc_now_iso() -> str:
     """ISO-8601 UTC to millisecond precision, 'Z'-suffixed."""
-    t = time.time()
+    # Wall-clock by intent: this IS the human/manifest timestamp path
+    # (rule monotonic-clock allows it only here, visibly).
+    t = time.time()  # graftcheck: disable=monotonic-clock
     return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + (
         ".%03dZ" % (int(t * 1000) % 1000)
     )
@@ -223,7 +227,9 @@ def event(kind: str, **fields: Any) -> None:
     """Record an event on the active journal; no-op without one."""
     journal = _active
     if journal is not None:
-        journal.event(kind, **fields)
+        # Forwarder, not an emit site: kind is the caller's literal
+        # (rule journal-catalog checks the call sites).
+        journal.event(kind, **fields)  # graftcheck: disable=journal-catalog
 
 
 # -- the shared stage runner scope ------------------------------------------
@@ -244,18 +250,20 @@ def stage_scope(name: str, done_suffix: str = "") -> Iterator[spans.SpanHandle]:
 
     stage_say(f"stage {name!r} ...")
     event("stage_start", stage=name)
-    t0 = time.time()
+    # perf_counter, not wall clock: an NTP step mid-stage used to produce
+    # negative (or hours-long) stage_done seconds (rule monotonic-clock).
+    t0 = time.perf_counter()
     try:
         with spans.span(f"stage:{name}") as handle:
             yield handle
     except BaseException as exc:
         event(
             "stage_error", stage=name,
-            seconds=round(time.time() - t0, 3),
+            seconds=round(time.perf_counter() - t0, 3),
             error=f"{type(exc).__name__}: {exc}",
         )
         raise
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
     stage_say(f"stage {name!r} done in {dt:.1f}s{done_suffix}")
     event(
         "stage_done", stage=name, seconds=round(dt, 3),

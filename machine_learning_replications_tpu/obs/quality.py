@@ -86,6 +86,23 @@ from machine_learning_replications_tpu.obs.registry import (
     MetricsRegistry,
 )
 
+# Registered at import (rule metrics-catalog): the first scrape of a
+# serving process sees the feed families' metadata before any feed
+# exists; the registry is idempotent across re-declares.
+QUALITY_FEED_DROPPED = REGISTRY.counter(
+    "quality_feed_dropped_rows_total",
+    "Rows that never reached the quality monitor, by reason: "
+    "sampled = thinned under queue pressure, overflow = shed at "
+    "a full hand-off queue, dead = feed quarantined.",
+    labels=("reason",),
+)
+for _reason in ("sampled", "overflow", "dead"):
+    QUALITY_FEED_DROPPED.labels(reason=_reason)
+QUALITY_FEED_DEPTH = REGISTRY.gauge(
+    "quality_feed_depth",
+    "Batches waiting in the async quality hand-off queue.",
+)
+
 PROFILE_VERSION = 1
 DEFAULT_FEATURE_BINS = 10
 DEFAULT_SCORE_BINS = 10
@@ -960,19 +977,8 @@ class AsyncQualityFeed:
         self._dropped_rows = 0
         self._sampled_out_rows = 0
         self._observed_rows = 0
-        self._c_dropped = REGISTRY.counter(
-            "quality_feed_dropped_rows_total",
-            "Rows that never reached the quality monitor, by reason: "
-            "sampled = thinned under queue pressure, overflow = shed at "
-            "a full hand-off queue, dead = feed quarantined.",
-            labels=("reason",),
-        )
-        for r in ("sampled", "overflow", "dead"):
-            self._c_dropped.labels(reason=r)
-        self._g_depth = REGISTRY.gauge(
-            "quality_feed_depth",
-            "Batches waiting in the async quality hand-off queue.",
-        )
+        self._c_dropped = QUALITY_FEED_DROPPED
+        self._g_depth = QUALITY_FEED_DEPTH
         self._g_depth.get().set(0.0)
         self._thread = threading.Thread(
             target=self._loop, name="quality-feed", daemon=True

@@ -48,6 +48,18 @@ import numpy as np
 from machine_learning_replications_tpu.obs import spans
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 
+# Registered at import (rule metrics-catalog): present on the first
+# scrape, before any recorder is constructed.
+REQTRACE_SAMPLED = REGISTRY.counter(
+    "reqtrace_sampled_total",
+    "Request traces kept by the flight recorder, by keep reason.",
+    labels=("reason",),
+)
+REQTRACE_DROPPED = REGISTRY.counter(
+    "reqtrace_dropped_total",
+    "Completed request traces dropped by tail sampling (fast majority).",
+)
+
 #: Phase names in request order (docs/OBSERVABILITY.md "Request traces").
 #: A device-path request records parse → queue_wait → batch_assembly →
 #: device_compute → respond; a host-path request (dual-path scoring,
@@ -111,7 +123,9 @@ class RequestTrace:
     def __init__(self, request_id: str | None = None) -> None:
         self.request_id = request_id or new_request_id()
         self.t_start = time.perf_counter()
-        self.wall_start = time.time()
+        # Display timestamp on the exported trace; phase durations
+        # use the span clock, never this.
+        self.wall_start = time.time()  # graftcheck: disable=monotonic-clock
         self.phases: dict[str, tuple[float, float]] = {}
         self.meta: dict[str, Any] = {}
         self.status: str | None = None
@@ -270,16 +284,8 @@ class FlightRecorder:
         self._dropped_n = 0  # THIS recorder's drops (the registry
         # counters below are process-global and would mix recorders)
         self._lane_busy_until = [0.0] * _N_LANES
-        self._sampled = REGISTRY.counter(
-            "reqtrace_sampled_total",
-            "Request traces kept by the flight recorder, by keep reason.",
-            labels=("reason",),
-        )
-        self._dropped = REGISTRY.counter(
-            "reqtrace_dropped_total",
-            "Completed request traces dropped by tail sampling (fast "
-            "majority).",
-        )
+        self._sampled = REQTRACE_SAMPLED
+        self._dropped = REQTRACE_DROPPED
 
     # -- sampling ----------------------------------------------------------
 

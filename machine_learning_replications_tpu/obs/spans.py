@@ -89,7 +89,9 @@ class Tracer:
         self._dropped = 0
         self.max_events = int(max_events)
         self._t0 = time.perf_counter()
-        self._epoch_unix = time.time()
+        # Wall-clock epoch anchor for the Chrome-trace export; all
+        # span math is monotonic and only display maps through this.
+        self._epoch_unix = time.time()  # graftcheck: disable=monotonic-clock
         self._pid = os.getpid()
         self._tids: dict[int, int] = {}  # thread ident -> small stable tid
         self._vtids: dict[str, int] = {}  # virtual track name -> tid

@@ -9,16 +9,6 @@ executing any pickled code* and converts them (or live sklearn estimators)
 into pytrees, seeding the numerical parity oracle of SURVEY.md §2.3.
 """
 
-from machine_learning_replications_tpu.persist.sklearn_import import (
-    REFERENCE_PKL_PATH,
-    decode_pickle,
-    import_stacking,
-    import_gbdt,
-    import_linear,
-    import_scaler,
-    import_svc,
-)
-
 def load_inference_params(model: str | None = None, pkl: str | None = None):
     """Resolve the inference param source every front end shares
     (``cli.py predict``, ``serve``): an Orbax checkpoint dir when ``model``
@@ -30,23 +20,41 @@ def load_inference_params(model: str | None = None, pkl: str | None = None):
         from machine_learning_replications_tpu.persist import orbax_io
 
         return orbax_io.load_model(model)
+    from machine_learning_replications_tpu.persist.sklearn_import import (
+        REFERENCE_PKL_PATH,
+        decode_pickle,
+        import_stacking,
+    )
+
     return import_stacking(decode_pickle(pkl or REFERENCE_PKL_PATH))
 
 
-# Orbax names resolve lazily (PEP 562) so the pickle-import path stays usable
-# in environments without orbax-checkpoint installed.
-_ORBAX_NAMES = (
-    "abstract_like", "restore_params", "save_params",
-    "checkpoint_version", "load_model_versioned",
-)
+# All re-exports resolve lazily (PEP 562, shared ``lazyimport`` helper).
+# Orbax names: the pickle-import path stays usable without
+# orbax-checkpoint installed. sklearn_import names: that module's pytree
+# types pull flax (hence jax) at import time, and this ``__init__``
+# executes for every ``persist.*`` consumer — ``score.pipeline`` imports
+# ``persist.atomicio``, whose import-time closure is declared jax-free
+# through ``score.reader`` (graftcheck rule import-purity, manifest in
+# analysis/project.py).
+from machine_learning_replications_tpu.lazyimport import lazy_exports
 
+_EXPORTS = {
+    "abstract_like": "orbax_io",
+    "restore_params": "orbax_io",
+    "save_params": "orbax_io",
+    "checkpoint_version": "orbax_io",
+    "load_model_versioned": "orbax_io",
+    "REFERENCE_PKL_PATH": "sklearn_import",
+    "decode_pickle": "sklearn_import",
+    "import_stacking": "sklearn_import",
+    "import_gbdt": "sklearn_import",
+    "import_linear": "sklearn_import",
+    "import_scaler": "sklearn_import",
+    "import_svc": "sklearn_import",
+}
 
-def __getattr__(name):
-    if name in _ORBAX_NAMES:
-        from machine_learning_replications_tpu.persist import orbax_io
-
-        return getattr(orbax_io, name)
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+__getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)
 
 
 __all__ = [

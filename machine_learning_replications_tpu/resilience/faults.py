@@ -8,7 +8,9 @@ counted. This module is the injection half of that loop.
 **Sites.** A faultpoint is a named call to ``fire(site)`` woven into a hot
 path. The catalog (``SITES``) is closed — arming an unknown site is an
 error, so a typo'd chaos spec fails at arm time, not by silently injecting
-nothing:
+nothing. graftcheck's ``faultpoint-coherence`` rule (docs/ANALYSIS.md)
+keeps the three views — ``fire()`` sites in code, this catalog, and the
+docs/RESILIENCE.md table — in exact agreement:
 
   ==================  =============================================  ==========
   site                where it fires                                 modes
@@ -103,7 +105,8 @@ SITES: dict[str, tuple[str, ...]] = {
 }
 
 # Registered at import so the family (and its exposition metadata) exists
-# on the first /metrics scrape of a chaos run, before anything fires.
+# on the first /metrics scrape of a chaos run, before anything fires
+# (rule metrics-catalog).
 FAULTS_INJECTED = REGISTRY.counter(
     "fault_injected_total",
     "Armed faultpoint firings by injection site (resilience.faults).",

@@ -26,17 +26,23 @@ with
 Entry point: ``cli.py score``; bench: ``tools/score_bench.py``.
 """
 
-from machine_learning_replications_tpu.score.pipeline import (  # noqa: F401
-    ScorePipeline,
-    ScoreBudgetExceeded,
-    ScoreInterrupted,
-)
-from machine_learning_replications_tpu.score.reader import (  # noqa: F401
-    JsonlCohortSource,
-    MatCohortSource,
-    open_cohort,
-)
-from machine_learning_replications_tpu.score.progress import (  # noqa: F401
-    ScoreProgress,
-    ScoreResumeError,
-)
+# Re-exports resolve lazily (PEP 562): this ``__init__`` executes before
+# any ``score.*`` submodule, and ``score.reader``'s parse path is declared
+# jax-free (graftcheck rule import-purity) — an eager ``pipeline`` import
+# here would put the whole device stage into the reader's import-time
+# closure.
+from machine_learning_replications_tpu.lazyimport import lazy_exports
+
+_EXPORTS = {
+    "ScorePipeline": "pipeline",
+    "ScoreBudgetExceeded": "pipeline",
+    "ScoreInterrupted": "pipeline",
+    "JsonlCohortSource": "reader",
+    "MatCohortSource": "reader",
+    "open_cohort": "reader",
+    "ScoreProgress": "progress",
+    "ScoreResumeError": "progress",
+}
+
+__all__ = sorted(_EXPORTS)
+__getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)

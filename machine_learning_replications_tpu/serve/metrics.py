@@ -84,7 +84,12 @@ class ServingMetrics:
         self.queue_wait = Histogram(QUEUE_WAIT_BUCKETS_S)
         self.batch_size = Histogram(batch_buckets)
         self.padding_waste = Histogram(batch_buckets)
-        self.started_at = time.time()
+        # Monotonic: uptime is duration arithmetic, and the wall
+        # clock jumps (NTP) — rule monotonic-clock.
+        self.started_monotonic = time.monotonic()
+
+    def uptime_seconds(self) -> float:
+        return time.monotonic() - self.started_monotonic
 
     def snapshot(self) -> dict:
         # Empty-window quantiles become None (JSON null): a bare NaN token
@@ -108,7 +113,7 @@ class ServingMetrics:
             "queue_wait_seconds": self.queue_wait.snapshot(),
             "batch_size": self.batch_size.snapshot(),
             "padding_waste": self.padding_waste.snapshot(),
-            "uptime_seconds": time.time() - self.started_at,
+            "uptime_seconds": self.uptime_seconds(),
         }
 
     def render_prometheus(self) -> str:

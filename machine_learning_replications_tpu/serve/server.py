@@ -188,6 +188,15 @@ MODEL_VERSION = REGISTRY.gauge(
     "serve_model_version",
     "Monotonic checkpoint version currently served (0 = unversioned).",
 )
+#: Pre-fork worker attribution through the shared SO_REUSEPORT port:
+#: constant 1, the worker label carries the id (registered at import,
+#: rule metrics-catalog; a single-worker process never sets a child).
+WORKER_INFO = REGISTRY.gauge(
+    "serve_worker_info",
+    "Serving worker identity (pre-fork multi-worker mode); constant 1, "
+    "the worker label carries the id.",
+    labels=("worker",),
+)
 
 
 def _retry_after(seconds: float) -> dict[str, str]:
@@ -335,7 +344,10 @@ class ServerHandle:
         t0 = time.monotonic()
         status: dict = {
             "state": "loading", "target": model_path,
-            "from_version": self.model_version, "started": time.time(),
+            "from_version": self.model_version,
+            # Display timestamp in the deploy-status payload; durations
+            # come from the monotonic t0 above.
+            "started": time.time(),  # graftcheck: disable=monotonic-clock
         }
         self.deploy_status = status
         journal.event(
@@ -867,7 +879,7 @@ class _App:
                 # /metrics and the per-reply X-Serve-Path header).
                 "host_path": handle.host is not None,
                 "uptime_seconds": round(
-                    time.time() - self.metrics.started_at, 3
+                    self.metrics.uptime_seconds(), 3
                 ),
                 "run_id": (
                     jrn.manifest.get("run_id") if jrn is not None else None
@@ -1480,12 +1492,7 @@ def make_server(
     if worker_id is not None:
         # Attribution through the shared SO_REUSEPORT port: every scrape
         # names the worker process it landed on.
-        REGISTRY.gauge(
-            "serve_worker_info",
-            "Serving worker identity (pre-fork multi-worker mode); "
-            "constant 1, the worker label carries the id.",
-            labels=("worker",),
-        ).set(1, worker=str(worker_id))
+        WORKER_INFO.set(1, worker=str(worker_id))
     if model_version is not None:
         MODEL_VERSION.get().set(float(model_version))
     handle = ServerHandle(

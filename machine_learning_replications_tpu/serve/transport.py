@@ -8,7 +8,10 @@ engine (2.9 ms single-row, 1.4 M rows/s batched) broke a sweat. This
 module replaces it with the standard single-threaded readiness loop
 (``selectors.DefaultSelector`` — epoll on Linux):
 
-  * **One loop thread** owns every socket. Reads feed the connection's
+  * **One loop thread** owns every socket (the contract is annotated
+    ``@loop_only`` / ``@cross_thread`` — ``contracts.py`` — and
+    statically enforced by graftcheck rule ``loop-discipline``,
+    docs/ANALYSIS.md). Reads feed the connection's
     ``protocol.RequestParser``; complete requests are dispatched to the
     application; response bytes queue on a per-connection write buffer
     flushed as the socket accepts them.
@@ -75,6 +78,10 @@ import time
 from collections import deque
 
 from machine_learning_replications_tpu.serve import protocol
+from machine_learning_replications_tpu.contracts import (
+    cross_thread,
+    loop_only,
+)
 
 _READ_CHUNK = 65536
 
@@ -141,6 +148,7 @@ class Responder:
             self._done = True
             return True
 
+    @cross_thread
     def send(
         self,
         code: int,
@@ -159,11 +167,13 @@ class Responder:
         )
         self._server._complete(self._conn, data, close=not keep)
 
+    @cross_thread
     def send_json(self, code: int, obj, **kw) -> None:
         import json
 
         self.send(code, json.dumps(obj).encode(), "application/json", **kw)
 
+    @cross_thread
     def abort(self) -> None:
         """Drop the connection without writing a byte."""
         if not self._claim():
@@ -240,6 +250,7 @@ class EventLoopHttpServer:
 
     # -- cross-thread entry points -----------------------------------------
 
+    @cross_thread
     def _post(self, fn) -> None:
         """Run ``fn`` on the loop thread (soon). Safe from any thread;
         silently dropped once the loop has exited (late completions after
@@ -253,6 +264,7 @@ class EventLoopHttpServer:
             except OSError:
                 pass
 
+    @loop_only
     def call_later(self, delay_s: float, fn) -> _Timer:
         """Schedule ``fn`` on the loop thread after ``delay_s``. Loop
         thread only (the request handlers run there); returns a handle
@@ -264,6 +276,7 @@ class EventLoopHttpServer:
 
     # -- loop --------------------------------------------------------------
 
+    @loop_only
     def serve_forever(self) -> None:
         self._running = True
         self._stopped.clear()
@@ -311,6 +324,7 @@ class EventLoopHttpServer:
             self._teardown()
             self._stopped.set()
 
+    @loop_only
     def _drained(self, now: float) -> bool:
         """Shutdown gate: every enqueued response flushed (or the drain
         deadline passed) — an admitted request's reply must not be cut off
@@ -321,6 +335,7 @@ class EventLoopHttpServer:
             c.in_flight or c.out_buf for c in self._conns.values()
         )
 
+    @loop_only
     def _run_pending(self) -> None:
         while True:
             with self._pending_lock:
@@ -332,6 +347,7 @@ class EventLoopHttpServer:
             except Exception:
                 pass  # a posted completion must never kill the loop
 
+    @loop_only
     def _run_timers(self, now: float) -> None:
         while self._timers and self._timers[0][0] <= now:
             _, _, t = heapq.heappop(self._timers)
@@ -342,6 +358,7 @@ class EventLoopHttpServer:
             except Exception:
                 pass  # a deadline callback must never kill the loop
 
+    @loop_only
     def _sweep_idle(self, now: float) -> None:
         # In-flight requests are exempt: their lifetime is bounded by the
         # application's own request deadline, and reaping them would cut
@@ -366,6 +383,7 @@ class EventLoopHttpServer:
 
     # -- connection lifecycle ----------------------------------------------
 
+    @loop_only
     def _accept(self) -> None:
         while True:
             try:
@@ -413,6 +431,7 @@ class EventLoopHttpServer:
             self._sel.register(sock, selectors.EVENT_READ, conn)
             conn.mask = selectors.EVENT_READ
 
+    @loop_only
     def _close_conn(self, conn: _Conn) -> None:
         if conn.closed:
             return
@@ -429,6 +448,7 @@ class EventLoopHttpServer:
         except OSError:
             pass
 
+    @loop_only
     def _set_interest(self, conn: _Conn, read: bool, write: bool) -> None:
         """Reconcile the selector mask with the wanted one — a no-op when
         unchanged, so the steady keep-alive path (read interest on for
@@ -446,6 +466,7 @@ class EventLoopHttpServer:
             self._sel.modify(conn.sock, mask, conn)
         conn.mask = mask
 
+    @loop_only
     def _backpressured(self, conn: _Conn) -> bool:
         """A connection that keeps streaming pipelined bytes while a
         request is in flight gets its read interest dropped once it has
@@ -454,6 +475,7 @@ class EventLoopHttpServer:
         return conn.parser.buffered >= \
             self.max_header_bytes + self.max_body_bytes
 
+    @loop_only
     def _readable(self, conn: _Conn) -> None:
         try:
             data = conn.sock.recv(_READ_CHUNK)
@@ -479,6 +501,7 @@ class EventLoopHttpServer:
             return
         self._advance(conn)
 
+    @loop_only
     def _advance(self, conn: _Conn) -> None:
         """Dispatch buffered requests while the connection is free. One
         request in flight per connection: while it is, the socket is not
@@ -542,6 +565,7 @@ class EventLoopHttpServer:
         else:
             self._complete_on_loop(conn, data, close)
 
+    @loop_only
     def _complete_on_loop(self, conn: _Conn, data: bytes,
                           close: bool) -> None:
         if conn.closed:
@@ -552,9 +576,11 @@ class EventLoopHttpServer:
         conn.last_activity = time.monotonic()
         self._flush_writes(conn)
 
+    @loop_only
     def _writable(self, conn: _Conn) -> None:
         self._flush_writes(conn)
 
+    @loop_only
     def _flush_writes(self, conn: _Conn) -> None:
         while conn.out_buf:
             try:
@@ -604,6 +630,7 @@ class EventLoopHttpServer:
             pass
         self._listener = None
 
+    @cross_thread
     def shutdown(self, flush_timeout_s: float = 10.0) -> None:
         """Stop the loop: close the listener, flush every queued response
         (bounded by ``flush_timeout_s``), then exit ``serve_forever``.
@@ -710,6 +737,7 @@ class UpstreamAttempt:
         self.reused = False
         self.resent = False
 
+    @loop_only
     def cancel(self) -> bool:
         """True when this call actually cancelled the attempt — False
         when it had already completed/failed (its ``on_done`` fired or
@@ -778,6 +806,7 @@ class UpstreamPool:
 
     # -- public API (loop thread) -------------------------------------------
 
+    @loop_only
     def request(self, key, addr: tuple[str, int], data: bytes,
                 timeout_s: float, on_done) -> UpstreamAttempt:
         """Send ``data`` (a fully rendered HTTP request) to ``addr``,
@@ -807,6 +836,7 @@ class UpstreamPool:
             "idle": sum(len(d) for d in self._idle.values()),
         }
 
+    @loop_only
     def close_all(self) -> None:
         """Drop every connection (loop teardown)."""
         self._closed = True
@@ -819,6 +849,7 @@ class UpstreamPool:
 
     # -- connection management ----------------------------------------------
 
+    @loop_only
     def _pop_idle(self, key) -> _UpstreamConn | None:
         dq = self._idle.get(key)
         while dq:
@@ -827,6 +858,7 @@ class UpstreamPool:
                 return conn
         return None
 
+    @loop_only
     def _open(self, att: UpstreamAttempt) -> None:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setblocking(False)
@@ -857,6 +889,7 @@ class UpstreamPool:
         else:
             self._set_interest(conn, selectors.EVENT_WRITE)
 
+    @loop_only
     def _bind(self, att: UpstreamAttempt, conn: _UpstreamConn) -> None:
         """Ride a pooled idle connection: the parser is empty by the
         pooling contract, so the next bytes read are this reply's."""
@@ -867,6 +900,7 @@ class UpstreamPool:
         conn.last_activity = time.monotonic()
         self._flush(conn)
 
+    @loop_only
     def _close_conn(self, conn: _UpstreamConn) -> None:
         if conn.closed:
             return
@@ -883,6 +917,7 @@ class UpstreamPool:
         except OSError:
             pass
 
+    @loop_only
     def _set_interest(self, conn: _UpstreamConn, mask: int) -> None:
         if mask == conn.mask:
             return
@@ -897,6 +932,7 @@ class UpstreamPool:
 
     # -- I/O (loop thread, dispatched by serve_forever) ----------------------
 
+    @loop_only
     def _on_io(self, conn: _UpstreamConn, mask: int) -> None:
         if conn.closed:
             return
@@ -921,6 +957,7 @@ class UpstreamPool:
         if mask & selectors.EVENT_READ:
             self._readable(conn)
 
+    @loop_only
     def _flush(self, conn: _UpstreamConn) -> None:
         """Write pending request bytes with explicit backpressure: a
         partial send re-arms write interest and the loop resumes when
@@ -947,6 +984,7 @@ class UpstreamPool:
             conn.last_activity = time.monotonic()
         self._set_interest(conn, selectors.EVENT_READ)
 
+    @loop_only
     def _readable(self, conn: _UpstreamConn) -> None:
         try:
             data = conn.sock.recv(_READ_CHUNK)
@@ -974,9 +1012,10 @@ class UpstreamPool:
             return
         if resp is None:
             return  # reply still in flight
-        self._complete(conn, att, resp)
+        self._complete_attempt(conn, att, resp)
 
-    def _complete(self, conn: _UpstreamConn, att: UpstreamAttempt,
+    @loop_only
+    def _complete_attempt(self, conn: _UpstreamConn, att: UpstreamAttempt,
                   resp) -> None:
         conn.served += 1
         conn.attempt = None
@@ -1007,6 +1046,7 @@ class UpstreamPool:
 
     # -- failure / retry / timeout -------------------------------------------
 
+    @loop_only
     def _conn_died(self, conn: _UpstreamConn, exc) -> None:
         """EOF or a transport error (reset, EPIPE) on an upstream
         connection — the ONE classification point, so the send path and
@@ -1034,6 +1074,7 @@ class UpstreamPool:
                 + (f": {exc}" if exc is not None else "")
             ))
 
+    @loop_only
     def _resend(self, att: UpstreamAttempt) -> None:
         if att.done:
             return
@@ -1041,6 +1082,7 @@ class UpstreamPool:
         att.conn = None
         self._open(att)
 
+    @loop_only
     def _fail(self, att: UpstreamAttempt, exc: Exception) -> None:
         if att.done:
             return
@@ -1062,6 +1104,7 @@ class UpstreamPool:
         # would see a half-constructed caller state.
         self.server._post(deliver)
 
+    @loop_only
     def _on_timeout(self, att: UpstreamAttempt) -> None:
         if att.done:
             return
@@ -1076,6 +1119,7 @@ class UpstreamPool:
 
     # -- idle reaping ---------------------------------------------------------
 
+    @loop_only
     def _ensure_sweep(self) -> None:
         if self._sweep_timer is not None or self._closed:
             return
@@ -1083,6 +1127,7 @@ class UpstreamPool:
             min(1.0, self.idle_timeout_s / 2), self._sweep
         )
 
+    @loop_only
     def _sweep(self) -> None:
         self._sweep_timer = None
         now = time.monotonic()

@@ -1,0 +1,751 @@
+"""graftcheck: the invariant checker checked (docs/ANALYSIS.md).
+
+Three layers:
+
+  * an accept/reject fixture matrix per rule — a tiny synthetic package
+    per case exercising the clean shape and the violating shape through
+    the same ``analysis.core`` API the CLI uses;
+  * suppression-comment and baseline-expiry semantics;
+  * the repo gate: the checker over THIS repository exits 0 in strict
+    mode, so pytest and the CI ``static-analysis`` job enforce the same
+    thing;
+  * regression tests for the behavioral violations the first run found
+    (wall-clock stage durations, lazily-registered metric families,
+    jax reachable from the declared-jax-free ``score.reader``).
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from analysis.core import Baseline, BaselineError, Project, run_rules  # noqa: E402
+from analysis.rules import (  # noqa: E402
+    ALL_RULES,
+    faultpoints,
+    import_purity,
+    journal_catalog,
+    loop_discipline,
+    metrics_catalog,
+    monotonic_clock,
+)
+
+
+def make_tree(root, files):
+    """Write ``{relpath: source}`` under root, creating directories."""
+    for rel, src in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(src))
+
+
+def project_for(root, **kw):
+    kw.setdefault("package", "pkg")
+    kw.setdefault("tool_dirs", ("tools",))
+    return Project(root=str(root), **kw)
+
+
+def run_one(rule, project, **kw):
+    return run_rules(project, [rule], **kw)
+
+
+# ---------------------------------------------------------------------------
+# R1 import-purity
+# ---------------------------------------------------------------------------
+
+
+class TestImportPurity:
+    def _project(self, tmp_path, files, jaxfree=("pkg.clean",)):
+        make_tree(tmp_path, {"pkg/__init__.py": "", **files})
+        return project_for(tmp_path, jaxfree=jaxfree)
+
+    def test_accepts_clean_module(self, tmp_path):
+        p = self._project(tmp_path, {
+            "pkg/clean.py": "import os\nimport json\n",
+        })
+        assert run_one(import_purity, p).findings == []
+
+    def test_accepts_lazy_function_scoped_jax(self, tmp_path):
+        p = self._project(tmp_path, {
+            "pkg/clean.py": "def f():\n    import jax\n    return jax\n",
+        })
+        assert run_one(import_purity, p).findings == []
+
+    def test_rejects_direct_import(self, tmp_path):
+        p = self._project(tmp_path, {"pkg/clean.py": "import jax\n"})
+        (f,) = run_one(import_purity, p).findings
+        assert f.rule == "import-purity"
+        assert "pkg.clean" in f.message and "jax" in f.message
+
+    def test_rejects_transitive_import(self, tmp_path):
+        p = self._project(tmp_path, {
+            "pkg/clean.py": "from pkg import helper\n",
+            "pkg/helper.py": "import jaxlib\n",
+        })
+        (f,) = run_one(import_purity, p).findings
+        assert "pkg.helper" in f.message
+
+    def test_rejects_parent_package_init_edge(self, tmp_path):
+        # importing pkg.sub.leaf executes pkg/sub/__init__.py — the
+        # score.reader regression this PR fixed
+        p = self._project(tmp_path, {
+            "pkg/sub/__init__.py": "from pkg.sub.heavy import X\n",
+            "pkg/sub/heavy.py": "import jax\nX = 1\n",
+            "pkg/sub/leaf.py": "import os\n",
+        }, jaxfree=("pkg.sub.leaf",))
+        (f,) = run_one(import_purity, p).findings
+        assert "pkg.sub.leaf" in f.message and "pkg.sub.heavy" in f.message
+
+    def test_rejects_guarded_module_level_import(self, tmp_path):
+        p = self._project(tmp_path, {
+            "pkg/clean.py": "try:\n    import jax\nexcept ImportError:"
+                            "\n    jax = None\n",
+        })
+        assert len(run_one(import_purity, p).findings) == 1
+
+    def test_rejects_missing_manifest_module(self, tmp_path):
+        p = self._project(tmp_path, {"pkg/clean.py": "import os\n"},
+                          jaxfree=("pkg.ghost",))
+        (f,) = run_one(import_purity, p).findings
+        assert "no such module" in f.message
+
+
+# ---------------------------------------------------------------------------
+# R2 loop-discipline
+# ---------------------------------------------------------------------------
+
+
+_LOOP_HEADER = """\
+    from pkg.contracts import loop_only, cross_thread
+    import time
+"""
+
+
+class TestLoopDiscipline:
+    def _project(self, tmp_path, body):
+        make_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/contracts.py": (
+                "def loop_only(fn):\n    return fn\n"
+                "def cross_thread(fn):\n    return fn\n"
+            ),
+            "pkg/loopy.py": _LOOP_HEADER + body,
+        })
+        return project_for(tmp_path)
+
+    def test_accepts_clean_loop_method(self, tmp_path):
+        p = self._project(tmp_path, """\
+    class S:
+        @loop_only
+        def tick(self):
+            self.n = 1
+
+        @cross_thread
+        def post(self, fn):
+            self.pending.append(fn)
+    """)
+        assert run_one(loop_discipline, p).findings == []
+
+    def test_rejects_sleep_in_loop(self, tmp_path):
+        p = self._project(tmp_path, """\
+    class S:
+        @loop_only
+        def tick(self):
+            time.sleep(1)
+    """)
+        (f,) = run_one(loop_discipline, p).findings
+        assert "time.sleep" in f.message
+
+    def test_rejects_http_client_and_blocking_connect(self, tmp_path):
+        p = self._project(tmp_path, """\
+    import http.client
+    import socket
+
+    class S:
+        @loop_only
+        def dial(self, addr):
+            http.client.HTTPConnection(addr)
+            s = socket.socket()
+            s.connect(addr)
+    """)
+        msgs = [f.message for f in run_one(loop_discipline, p).findings]
+        assert any("http.client" in m for m in msgs)
+        assert any("connect" in m for m in msgs)
+
+    def test_rejects_untimed_acquire_accepts_timed(self, tmp_path):
+        p = self._project(tmp_path, """\
+    class S:
+        @loop_only
+        def bad(self):
+            self.lock.acquire()
+
+        @loop_only
+        def good(self):
+            self.lock.acquire(timeout=1.0)
+    """)
+        findings = run_one(loop_discipline, p).findings
+        assert len(findings) == 1 and "acquire" in findings[0].message
+
+    def test_rejects_blocking_true_acquire_variants(self, tmp_path):
+        # acquire(True) / acquire(blocking=True) are exactly the
+        # un-timed blocking acquire the rule bans; acquire(False),
+        # acquire(blocking=False), and acquire(True, 5) are bounded
+        p = self._project(tmp_path, """\
+    class S:
+        @loop_only
+        def bad_positional(self):
+            self.lock.acquire(True)
+
+        @loop_only
+        def bad_keyword(self):
+            self.lock.acquire(blocking=True)
+
+        @loop_only
+        def ok_nonblocking(self):
+            self.lock.acquire(False)
+            self.lock.acquire(blocking=False)
+            self.lock.acquire(True, 5)
+    """)
+        findings = run_one(loop_discipline, p).findings
+        assert len(findings) == 2
+        assert all("acquire" in f.message for f in findings)
+        assert {f.message.split(" ")[1].rstrip(":") for f in findings} \
+            == {"bad_positional", "bad_keyword"}
+
+    def test_rejects_cross_thread_calling_loop_only(self, tmp_path):
+        p = self._project(tmp_path, """\
+    class S:
+        @loop_only
+        def advance(self):
+            pass
+
+        @cross_thread
+        def send(self):
+            self.advance()
+    """)
+        (f,) = run_one(loop_discipline, p).findings
+        assert "advance" in f.message
+
+    def test_accepts_closure_marshalled_call(self, tmp_path):
+        # a lambda/closure runs later ON the loop; its body is not the
+        # cross-thread function's own thread context
+        p = self._project(tmp_path, """\
+    class S:
+        @loop_only
+        def advance(self):
+            pass
+
+        @cross_thread
+        def send(self):
+            self.post(lambda: self.advance())
+    """)
+        assert run_one(loop_discipline, p).findings == []
+
+    def test_rejects_both_decorators(self, tmp_path):
+        p = self._project(tmp_path, """\
+    class S:
+        @loop_only
+        @cross_thread
+        def confused(self):
+            pass
+    """)
+        (f,) = run_one(loop_discipline, p).findings
+        assert "one thread contract" in f.message
+
+
+# ---------------------------------------------------------------------------
+# R3 metrics-catalog
+# ---------------------------------------------------------------------------
+
+
+_CATALOG = """\
+    METRICS = {
+        "app_requests_total": ("counter", ("route",)),
+        "app_depth": ("gauge", ()),
+    }
+    EVENTS = {}
+"""
+
+
+class TestMetricsCatalog:
+    def _project(self, tmp_path, metrics_src, catalog=_CATALOG,
+                 doc="`app_requests_total` `app_depth`"):
+        make_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/catalog.py": catalog,
+            "pkg/m.py": metrics_src,
+            "docs/OBS.md": doc,
+        })
+        return project_for(
+            tmp_path, catalog_path="pkg/catalog.py",
+            observability_doc="docs/OBS.md",
+        )
+
+    def test_accepts_cataloged_top_level_family(self, tmp_path):
+        p = self._project(tmp_path, """\
+    REQS = REGISTRY.counter("app_requests_total", "h", labels=("route",))
+    DEPTH = REGISTRY.gauge("app_depth", "h")
+    """)
+        assert run_one(metrics_catalog, p).findings == []
+
+    def test_rejects_nested_global_registration(self, tmp_path):
+        p = self._project(tmp_path, """\
+    DEPTH = REGISTRY.gauge("app_depth", "h")
+    def make():
+        return REGISTRY.counter(
+            "app_requests_total", "h", labels=("route",))
+    """)
+        msgs = [f.message for f in run_one(metrics_catalog, p).findings]
+        assert any("module import" in m for m in msgs)
+
+    def test_accepts_instance_registry_in_constructor(self, tmp_path):
+        p = self._project(tmp_path, """\
+    DEPTH = REGISTRY.gauge("app_depth", "h")
+    class T:
+        def __init__(self, reg):
+            self.c = reg.counter(
+                "app_requests_total", "h", labels=("route",))
+    """)
+        assert run_one(metrics_catalog, p).findings == []
+
+    def test_rejects_computed_name(self, tmp_path):
+        p = self._project(tmp_path, """\
+    REQS = REGISTRY.counter("app_requests_total", "h", labels=("route",))
+    DEPTH = REGISTRY.gauge("app_depth", "h")
+    EXTRA = REGISTRY.gauge(f"app_{kind}", "h")
+    """)
+        msgs = [f.message for f in run_one(metrics_catalog, p).findings]
+        assert any("string literal" in m for m in msgs)
+
+    def test_rejects_uncataloged_and_naming_violations(self, tmp_path):
+        p = self._project(tmp_path, """\
+    REQS = REGISTRY.counter("app_requests_total", "h", labels=("route",))
+    DEPTH = REGISTRY.gauge("app_depth", "h")
+    ROGUE = REGISTRY.counter("app_rogue_count", "h")
+    """)
+        msgs = [f.message for f in run_one(metrics_catalog, p).findings]
+        assert any("not declared in the METRICS catalog" in m
+                   for m in msgs)
+        assert any("_total" in m for m in msgs)
+
+    def test_rejects_conflicting_label_sets(self, tmp_path):
+        p = self._project(tmp_path, """\
+    A = REGISTRY.counter("app_requests_total", "h", labels=("route",))
+    DEPTH = REGISTRY.gauge("app_depth", "h")
+    def other(reg):
+        return reg.counter("app_requests_total", "h", labels=("verb",))
+    """)
+        msgs = [f.message for f in run_one(metrics_catalog, p).findings]
+        assert any("conflicting label sets" in m for m in msgs)
+
+    def test_rejects_dead_catalog_entry_and_undocumented(self, tmp_path):
+        p = self._project(tmp_path, """\
+    DEPTH = REGISTRY.gauge("app_depth", "h")
+    """, doc="only `app_depth` documented here")
+        msgs = [f.message for f in run_one(metrics_catalog, p).findings]
+        assert any("registered nowhere" in m for m in msgs)
+        assert any("undocumented" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# R4 journal-catalog
+# ---------------------------------------------------------------------------
+
+
+_EVENTS_CATALOG = """\
+    METRICS = {}
+    EVENTS = {
+        "stage_done": ("stage", "seconds"),
+        "flush": ("seq",),
+    }
+"""
+
+
+class TestJournalCatalog:
+    def _project(self, tmp_path, src, catalog=_EVENTS_CATALOG):
+        make_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/catalog.py": catalog,
+            "pkg/j.py": src,
+        })
+        return project_for(tmp_path, catalog_path="pkg/catalog.py")
+
+    def test_accepts_cataloged_events_with_keys(self, tmp_path):
+        p = self._project(tmp_path, """\
+    journal.event("stage_done", stage="fit", seconds=1.2)
+    journal.event("flush", seq=3, rows=8)
+    """)
+        assert run_one(journal_catalog, p).findings == []
+
+    def test_rejects_unknown_event_name(self, tmp_path):
+        p = self._project(tmp_path, """\
+    journal.event("stage_done", stage="fit", seconds=1.2)
+    journal.event("flush", seq=1)
+    journal.event("stage_doen", stage="fit", seconds=1.2)
+    """)
+        msgs = [f.message for f in run_one(journal_catalog, p).findings]
+        assert any("'stage_doen' is not in the EVENTS catalog" in m
+                   for m in msgs)
+
+    def test_rejects_missing_required_key(self, tmp_path):
+        p = self._project(tmp_path, """\
+    journal.event("stage_done", stage="fit")
+    journal.event("flush", seq=1)
+    """)
+        msgs = [f.message for f in run_one(journal_catalog, p).findings]
+        assert any("missing required keys ['seconds']" in m for m in msgs)
+
+    def test_spread_satisfies_keys_but_name_still_checked(self, tmp_path):
+        p = self._project(tmp_path, """\
+    journal.event("stage_done", **info)
+    journal.event("flush", seq=1)
+    journal.event("mystery", **info)
+    """)
+        msgs = [f.message for f in run_one(journal_catalog, p).findings]
+        assert len(msgs) == 1 and "mystery" in msgs[0]
+
+    def test_rejects_computed_kind_and_dead_entry(self, tmp_path):
+        p = self._project(tmp_path, """\
+    journal.event(kind_var, x=1)
+    journal.event("stage_done", stage="s", seconds=0.1)
+    """)
+        msgs = [f.message for f in run_one(journal_catalog, p).findings]
+        assert any("string literal" in m for m in msgs)
+        assert any("'flush' is emitted nowhere" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# R5 monotonic-clock
+# ---------------------------------------------------------------------------
+
+
+class TestMonotonicClock:
+    def _project(self, tmp_path, src):
+        make_tree(tmp_path, {"pkg/__init__.py": "", "pkg/t.py": src})
+        return project_for(tmp_path)
+
+    def test_accepts_monotonic_and_perf_counter(self, tmp_path):
+        p = self._project(tmp_path, """\
+    import time
+    t0 = time.perf_counter()
+    deadline = time.monotonic() + 5
+    """)
+        assert run_one(monotonic_clock, p).findings == []
+
+    def test_rejects_wall_clock_calls(self, tmp_path):
+        p = self._project(tmp_path, """\
+    import time
+    import datetime
+    a = time.time()
+    b = datetime.datetime.now()
+    c = datetime.datetime.utcnow()
+    """)
+        assert len(run_one(monotonic_clock, p).findings) == 3
+
+    def test_line_suppression_allows_timestamps(self, tmp_path):
+        p = self._project(tmp_path, """\
+    import time
+    stamp = time.time()  # graftcheck: disable=monotonic-clock
+    dur = time.time()
+    """)
+        report = run_one(monotonic_clock, p)
+        assert len(report.findings) == 1
+        assert report.findings[0].line == 3
+        assert report.suppressed_count == 1
+
+    def test_file_suppression(self, tmp_path):
+        p = self._project(tmp_path, """\
+    # graftcheck: disable-file=monotonic-clock
+    import time
+    a = time.time()
+    b = time.time()
+    """)
+        report = run_one(monotonic_clock, p)
+        assert report.findings == [] and report.suppressed_count == 2
+
+
+# ---------------------------------------------------------------------------
+# R6 faultpoint-coherence
+# ---------------------------------------------------------------------------
+
+
+_FAULTS = """\
+    SITES = {
+        "server.parse": ("raise", "delay"),
+        "engine.compute": ("raise", "delay"),
+    }
+"""
+_DOC_OK = """\
+    | site | fires where | modes |
+    |---|---|---|
+    | `server.parse` | admission | raise, delay |
+    | `engine.compute` | predict | raise, delay |
+"""
+
+
+class TestFaultpointCoherence:
+    def _project(self, tmp_path, fire_src, faults=_FAULTS, doc=_DOC_OK):
+        make_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/faults.py": faults,
+            "pkg/hot.py": fire_src,
+            "docs/RES.md": doc,
+        })
+        return project_for(
+            tmp_path, faults_path="pkg/faults.py",
+            resilience_doc="docs/RES.md",
+        )
+
+    def test_accepts_coherent_views(self, tmp_path):
+        p = self._project(tmp_path, """\
+    faults.fire("server.parse")
+    faults.fire("engine.compute")
+    """)
+        assert run_one(faultpoints, p).findings == []
+
+    def test_rejects_unknown_fire_site(self, tmp_path):
+        p = self._project(tmp_path, """\
+    faults.fire("server.parse")
+    faults.fire("engine.compute")
+    faults.fire("server.typo")
+    """)
+        msgs = [f.message for f in run_one(faultpoints, p).findings]
+        assert any("server.typo" in m and "missing from the SITES" in m
+                   for m in msgs)
+
+    def test_rejects_dead_catalog_site_and_doc_drift(self, tmp_path):
+        p = self._project(
+            tmp_path, 'faults.fire("server.parse")\n',
+            doc="| `server.parse` | admission | raise |\n"
+                "| `server.ghost` | nowhere | raise |\n",
+        )
+        msgs = [f.message for f in run_one(faultpoints, p).findings]
+        assert any("'engine.compute' has no fire() site" in m
+                   for m in msgs)
+        assert any("'engine.compute' is in SITES but missing" in m
+                   for m in msgs)
+        assert any("documents site 'server.ghost'" in m for m in msgs)
+
+    def test_rejects_computed_site(self, tmp_path):
+        p = self._project(tmp_path, """\
+    faults.fire("server.parse")
+    faults.fire("engine.compute")
+    faults.fire(site_var)
+    """)
+        msgs = [f.message for f in run_one(faultpoints, p).findings]
+        assert any("computed site" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline semantics
+# ---------------------------------------------------------------------------
+
+
+class TestBaseline:
+    def _project(self, tmp_path):
+        make_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/t.py": "import time\na = time.time()\n",
+        })
+        return project_for(tmp_path)
+
+    def test_active_baseline_demotes_finding(self, tmp_path):
+        p = self._project(tmp_path)
+        b = Baseline([{
+            "rule": "monotonic-clock", "path": "pkg/t.py",
+            "reason": "migrating in PR N+1", "expires": "2030-01-01",
+        }])
+        report = run_rules(p, [monotonic_clock], baseline=b,
+                           today=datetime.date(2026, 8, 4))
+        assert report.findings == []
+        assert len(report.baselined) == 1
+        assert not report.failed()
+
+    def test_expired_baseline_fails_again(self, tmp_path):
+        p = self._project(tmp_path)
+        b = Baseline([{
+            "rule": "monotonic-clock", "path": "pkg/t.py",
+            "reason": "was due last year", "expires": "2025-06-01",
+        }])
+        report = run_rules(p, [monotonic_clock], baseline=b,
+                           today=datetime.date(2026, 8, 4))
+        assert report.findings == []
+        assert len(report.expired) == 1
+        assert report.failed()
+
+    def test_stale_entry_fails(self, tmp_path):
+        p = self._project(tmp_path)
+        b = Baseline([{
+            "rule": "monotonic-clock", "path": "pkg/other.py",
+            "reason": "file was deleted", "expires": "2030-01-01",
+        }])
+        report = run_rules(p, [monotonic_clock], baseline=b,
+                           today=datetime.date(2026, 8, 4))
+        assert len(report.unused_baseline) == 1
+        assert report.failed()
+
+    def test_unrun_rules_entries_are_not_stale(self, tmp_path):
+        # --rules subset: a baseline entry for a rule that did not run
+        # cannot be proven stale and must not fail the run
+        p = self._project(tmp_path)
+        b = Baseline([{
+            "rule": "monotonic-clock", "path": "pkg/t.py",
+            "reason": "grandfathered", "expires": "2030-01-01",
+        }])
+        report = run_rules(p, [import_purity], baseline=b,
+                           today=datetime.date(2026, 8, 4))
+        assert report.unused_baseline == []
+        assert not report.failed()
+
+    def test_malformed_baseline_rejected(self):
+        with pytest.raises(BaselineError):
+            Baseline([{"rule": "x", "path": "y"}])
+        with pytest.raises(BaselineError):
+            Baseline([{"rule": "x", "path": "y", "reason": "z",
+                       "expires": "soonish"}])
+
+
+# ---------------------------------------------------------------------------
+# the repo gate + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_repo_is_clean_under_strict(self, tmp_path):
+        """The same gate CI runs: every rule over the real repo, strict.
+        A finding here means a contract regressed — fix it or baseline
+        it with an expiry in analysis/baseline.json."""
+        out = tmp_path / "graftcheck.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "graftcheck.py"),
+             "--strict", "--json-out", str(out)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, (
+            f"graftcheck --strict failed:\n{proc.stdout}{proc.stderr}"
+        )
+        payload = json.loads(out.read_text())
+        assert payload["failed"] is False
+        assert len(payload["rules_run"]) >= 6
+        assert payload["files_scanned"] > 80
+
+    def test_cli_rule_subset_and_unknown_rule(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "graftcheck.py"),
+             "--rules", "no-such-rule"],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 2
+        assert "unknown rule" in proc.stderr
+
+    def test_all_rules_have_unique_ids(self):
+        ids = [r.RULE_ID for r in ALL_RULES]
+        assert len(ids) == len(set(ids)) == 6
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the behavioral fixes this checker surfaced
+# ---------------------------------------------------------------------------
+
+
+class TestBehavioralFixes:
+    def test_stage_scope_duration_survives_wall_clock_jump(
+            self, tmp_path, monkeypatch):
+        """stage_scope used time.time() for stage durations: an NTP step
+        backward mid-stage journaled a negative seconds. Durations now
+        come from perf_counter, so a wall jump must not affect them."""
+        import time as time_mod
+
+        from machine_learning_replications_tpu.obs import journal
+
+        jumps = iter([1_000_000.0, 999_000.0, 998_000.0, 997_000.0])
+
+        real_time = time_mod.time
+        monkeypatch.setattr(
+            time_mod, "time",
+            lambda: next(jumps, real_time()),
+        )
+        path = tmp_path / "j.jsonl"
+        with journal.RunJournal(path) as jrn:
+            journal.set_journal(jrn)
+            try:
+                with journal.stage_scope("jumpy"):
+                    pass
+            finally:
+                journal.set_journal(None)
+        events = [json.loads(line) for line in
+                  path.read_text().splitlines()]
+        done = [e for e in events if e.get("kind") == "stage_done"]
+        assert done and done[0]["seconds"] >= 0.0
+
+    def test_feed_and_reqtrace_families_register_at_import(self):
+        """The first scrape of a fresh process must see every family —
+        these used to appear only when the first feed/recorder was
+        constructed."""
+        proc = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent("""\
+                from machine_learning_replications_tpu.obs import (
+                    quality, reqtrace,
+                )
+                from machine_learning_replications_tpu.obs.registry \\
+                    import REGISTRY
+                page = REGISTRY.render_prometheus()
+                for family in (
+                    "quality_feed_dropped_rows_total",
+                    "quality_feed_depth",
+                    "reqtrace_sampled_total",
+                    "reqtrace_dropped_total",
+                ):
+                    assert f"# TYPE {family}" in page, family
+                print("OK")
+            """)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout
+
+    def test_score_reader_import_is_jax_free(self):
+        """score.reader's parse path is in the jax-free manifest; its
+        import used to drag jax in through data/__init__ (and flax
+        through persist/__init__ -> models)."""
+        proc = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent("""\
+                import sys
+                import machine_learning_replications_tpu.score.reader
+                bad = sorted(
+                    m for m in sys.modules
+                    if m.split(".")[0] in ("jax", "jaxlib", "flax")
+                )
+                assert not bad, bad
+                print("OK")
+            """)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_uptime_is_monotonic_based(self, monkeypatch):
+        """Serving uptime used wall-clock subtraction; a backward NTP
+        step made it negative."""
+        import time as time_mod
+
+        from machine_learning_replications_tpu.serve.metrics import (
+            ServingMetrics,
+        )
+
+        m = ServingMetrics()
+        monkeypatch.setattr(
+            time_mod, "time", lambda: -10_000.0
+        )
+        assert m.uptime_seconds() >= 0.0
+        assert m.snapshot()["uptime_seconds"] >= 0.0

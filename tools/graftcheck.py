@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""graftcheck — run the repo's invariant checker (docs/ANALYSIS.md).
+
+The CI gate::
+
+    python tools/graftcheck.py --strict
+
+Exit codes: 0 clean (live findings may exist only in non-strict report
+mode), 1 violations (non-baselined findings, expired baseline entries,
+or stale baseline entries matching nothing), 2 usage/configuration
+errors (unparseable baseline, unknown rule name).
+
+Useful flags::
+
+    --rules import-purity,monotonic-clock   run a subset
+    --json-out PATH   machine-readable report (tools/obs_report.py
+                      renders it as the "Static analysis" section)
+    --baseline PATH   override analysis/baseline.json
+    --root PATH       check a different tree (the fixture tests do)
+
+Suppressions and the expiring baseline are documented in
+docs/ANALYSIS.md; every suppression names its rule at the site, and
+every baseline entry carries a reason and an expiry date that turns it
+back into a failure when stale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from analysis.core import Baseline, BaselineError, run_rules  # noqa: E402
+from analysis.project import baseline_path, default_project  # noqa: E402
+from analysis.rules import ALL_RULES  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--root", default=None, help="tree to check "
+                    "(default: this repository)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any live finding, expired baseline "
+                    "entry, or stale baseline entry (the CI mode)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: analysis/baseline.json)")
+    ap.add_argument("--json-out", default=None,
+                    help="write the machine-readable report here")
+    ap.add_argument("--today", default=None,
+                    help="override today's date (YYYY-MM-DD; baseline-"
+                    "expiry tests)")
+    args = ap.parse_args(argv)
+
+    project = default_project(args.root)
+    rules = list(ALL_RULES)
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        by_id = {r.RULE_ID: r for r in ALL_RULES}
+        unknown = [w for w in wanted if w not in by_id]
+        if unknown:
+            print(f"graftcheck: unknown rule(s) {unknown}; known: "
+                  f"{sorted(by_id)}", file=sys.stderr)
+            return 2
+        rules = [by_id[w] for w in wanted]
+
+    baseline_file = args.baseline or baseline_path(args.root)
+    try:
+        baseline = Baseline.load(baseline_file)
+    except (BaselineError, json.JSONDecodeError) as exc:
+        print(f"graftcheck: bad baseline: {exc}", file=sys.stderr)
+        return 2
+    today = (
+        datetime.date.fromisoformat(args.today) if args.today else None
+    )
+
+    report = run_rules(project, rules, baseline=baseline, today=today)
+
+    for f in report.findings:
+        print(f"{f.location()}: [{f.rule}] {f.message}")
+    for f, e in report.expired:
+        print(f"{f.location()}: [{f.rule}] BASELINE EXPIRED "
+              f"{e['expires']} ({e['reason']}): {f.message}")
+    for e in report.unused_baseline:
+        print(f"{baseline_file}: [{e['rule']}] stale baseline "
+              f"entry for {e['path']} matches nothing — remove it")
+    n_live = len(report.findings)
+    n_exp = len(report.expired)
+    n_stale = len(report.unused_baseline)
+    print(
+        f"graftcheck: {len(report.rules_run)} rules over "
+        f"{report.files_scanned} files — {n_live} finding(s), "
+        f"{len(report.baselined)} baselined, {n_exp} expired, "
+        f"{n_stale} stale baseline entr(y/ies), "
+        f"{report.suppressed_count} suppressed"
+    )
+    if report.baselined:
+        oldest = min(e["expires"] for _, e in report.baselined)
+        print(f"graftcheck: baseline debt: {len(report.baselined)} "
+              f"grandfathered finding(s), oldest expiry {oldest}")
+
+    if args.json_out:
+        payload = report.to_json()
+        payload["strict"] = bool(args.strict)
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if report.failed():
+        return 1 if args.strict else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -942,6 +942,48 @@ def _section_join(rep: Report, bench: dict | None, requests: dict | None):
     )
 
 
+def _section_static_analysis(rep: Report, gc: dict | None):
+    """The last graftcheck run (docs/ANALYSIS.md), from its --json-out
+    artifact: rules run, live findings, baseline debt and its oldest
+    expiry — the repo-contract health alongside the runtime story."""
+    if gc is None:
+        return
+    rep.h("Static analysis")
+    rep.kv("rules run", ", ".join(gc.get("rules_run", [])) or "none")
+    rep.kv("files scanned", gc.get("files_scanned"))
+    verdict = "FAILED" if gc.get("failed") else "clean"
+    rep.kv("verdict", f"{verdict} ({'strict' if gc.get('strict') else 'report-only'} mode)")
+    rep.kv("suppressed (annotated call sites)", gc.get("suppressed"))
+    findings = gc.get("findings") or []
+    expired = gc.get("expired") or []
+    stale = gc.get("unused_baseline") or []
+    if findings or expired:
+        rep.table(
+            ("rule", "location", "finding"),
+            [(f["rule"], f"{f['path']}:{f['line']}", f["message"])
+             for f in findings]
+            + [(e["rule"], f"{e['path']}:{e['line']}",
+                f"BASELINE EXPIRED {e['expires']}: {e['message']}")
+               for e in expired],
+        )
+    baselined = gc.get("baselined") or []
+    if baselined:
+        oldest = min(b["expires"] for b in baselined)
+        rep.kv(
+            "baseline debt",
+            f"{len(baselined)} grandfathered finding(s), oldest expiry "
+            f"{oldest}",
+        )
+    else:
+        rep.kv("baseline debt", "none")
+    if stale:
+        rep.kv(
+            "stale baseline entries",
+            "; ".join(f"{e['rule']}:{e['path']}" for e in stale)
+            + " — remove them",
+        )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--url", help="live server base URL")
@@ -977,12 +1019,18 @@ def main(argv=None) -> int:
         "the bracketing quality transitions, joined from the --journal "
         "set; --bench joins the driving loadgen perturbation)",
     )
+    ap.add_argument(
+        "--graftcheck",
+        help="a tools/graftcheck.py --json-out artifact: renders the "
+        "'Static analysis' section (rules run, findings, baseline debt "
+        "+ oldest expiry)",
+    )
     ap.add_argument("--tail", type=int, default=10,
                     help="slowest sampled traces to show")
     ap.add_argument("--out", help="report path (default: stdout)")
     args = ap.parse_args(argv)
     if not (args.url or args.journal or args.metrics or args.requests
-            or args.quality or args.score_bench):
+            or args.quality or args.score_bench or args.graftcheck):
         ap.error("nothing to report on: give --url and/or input files")
 
     health = metrics = requests = quality = fleet_replicas = None
@@ -1025,6 +1073,9 @@ def main(argv=None) -> int:
 
     rep = Report()
     _section_run(rep, manifest, health)
+    _section_static_analysis(
+        rep, _load_json(args.graftcheck) if args.graftcheck else None
+    )
     if args.learn:
         # The continual-learning arc leads; the fleet/serving sections
         # below (if requested) then detail the machinery it rode.
