@@ -206,8 +206,12 @@ def _run_train(args, cfg) -> int:
     if args.save:
         from machine_learning_replications_tpu.persist import orbax_io
 
-        orbax_io.save_model(args.save, params)
-        print(f"model checkpointed to {args.save}", file=sys.stderr)
+        orbax_io.save_model(args.save, params, aot=args.aot)
+        print(
+            "model checkpointed to "
+            f"{args.save}{' (with AOT executable bundle)' if args.aot else ''}",
+            file=sys.stderr,
+        )
     return 0
 
 
@@ -365,6 +369,7 @@ def cmd_serve(args) -> int:
         "max_connections": args.max_connections,
         "host_path": not args.no_host_path,
         "host_workers": args.host_workers,
+        "no_aot": args.no_aot,
         "replica_id": args.replica_id,
         "register": args.register,
         "admin_endpoint": args.admin_endpoint,
@@ -496,17 +501,36 @@ def _run_serve(args, buckets) -> int:
     # parameter (passed below) — one code path for a security-relevant
     # switch.
 
-    from machine_learning_replications_tpu.persist import load_inference_params
-
-    params = load_inference_params(model=args.model, pkl=args.pkl)
     # Fleet identity (docs/FLEET.md): the checkpoint's monotonic version
     # id rides every reply as X-Model-Version; a pickle-imported model is
-    # simply unversioned.
+    # simply unversioned. Version AND bundle come from the directory that
+    # ACTUALLY restored: a corrupt target rolls back to the retained
+    # last-known-good, and labeling the lastgood's bits with the corrupt
+    # target's version (or restoring ITS executables) would break the
+    # one-bit-pattern-per-version fleet contract — the same invariant
+    # ServerHandle.deploy_model keys on info["path"].
     model_version = None
+    aot_bundle = None
     if args.model:
         from machine_learning_replications_tpu.persist import orbax_io
 
-        model_version = orbax_io.checkpoint_version(args.model)
+        params, restore_info = orbax_io.load_model_versioned(args.model)
+        model_version = restore_info["version"]
+        if not args.no_aot:
+            # Published AOT executables (docs/AOT.md): warmup restores
+            # instead of tracing. A checkpoint without a bundle — or
+            # --no-aot — serves exactly as before.
+            from machine_learning_replications_tpu.persist import (
+                aot as aot_mod,
+            )
+
+            aot_bundle = aot_mod.load_bundle(restore_info["path"])
+    else:
+        from machine_learning_replications_tpu.persist import (
+            load_inference_params,
+        )
+
+        params = load_inference_params(pkl=args.pkl)
     replica_id = args.replica_id
     handle = make_server(
         params,
@@ -553,6 +577,8 @@ def _run_serve(args, buckets) -> int:
         model_version=model_version,
         replica_id=replica_id,
         admin_endpoint=args.admin_endpoint,
+        aot_bundle=aot_bundle,
+        use_aot=not args.no_aot,
     )
     # Serving-process GC hygiene (the Instagram pre-fork trick): the
     # warm startup heap — jax, XLA executables, the uploaded ensemble —
@@ -1026,6 +1052,7 @@ def _run_fleet_autoscale(args) -> int:
         host=args.replica_host,
         serve_args=tuple(args.serve_arg or []),
         journal_dir=args.replica_journal_dir,
+        no_aot=args.no_aot,
     )
     try:
         manager = LifecycleManager(
@@ -1346,7 +1373,7 @@ def _run_learn_promote(args) -> int:
         )
     result = promod.promote(
         candidate_dir, args.model, args.router, verdict,
-        deploy_timeout_s=args.timeout,
+        deploy_timeout_s=args.timeout, aot=not args.no_aot,
     )
     print(json.dumps(result, indent=1))
     return 0 if result["result"] == "promoted" else 1
@@ -1452,8 +1479,11 @@ def cmd_import_sklearn(args) -> int:
 
     pkl = args.pkl or REFERENCE_PKL_PATH
     params = import_stacking(decode_pickle(pkl))
-    orbax_io.save_model(args.out, params)
-    print(f"imported {pkl} -> {args.out}")
+    orbax_io.save_model(args.out, params, aot=args.aot)
+    print(
+        f"imported {pkl} -> {args.out}"
+        + (" (with AOT executable bundle)" if args.aot else "")
+    )
     return 0
 
 
@@ -1510,6 +1540,12 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="fit the full pipeline and evaluate")
     add_cohort_flags(t)
     t.add_argument("--save", help="Orbax checkpoint directory to write")
+    t.add_argument(
+        "--aot", action="store_true",
+        help="export the AOT executable bundle into --save (docs/AOT.md): "
+        "pays the serving ladder's compile bill once at publish so every "
+        "replica restores executables instead of tracing at warmup",
+    )
     t.add_argument("--plots", help="directory for roc.png / pr.png")
     add_mesh_flags(
         t, "routes the GBDT member through the row-sharded trainers"
@@ -1676,6 +1712,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--fault-endpoint", action="store_true",
         help="enable the guarded /debug/faults chaos endpoint without "
         "arming anything at startup",
+    )
+    v.add_argument(
+        "--no-aot", action="store_true",
+        help="ignore published AOT executable bundles and always "
+        "trace+compile at warmup (and on later /admin/deploy swaps) — "
+        "the operator escape hatch for a bad serialized artifact "
+        "(docs/AOT.md; AOT restore itself already fails open to "
+        "tracing per bucket)",
     )
     v.add_argument(
         "--no-host-path", action="store_true",
@@ -1927,6 +1971,13 @@ def build_parser() -> argparse.ArgumentParser:
         "dash: --serve-arg=--buckets --serve-arg=1,8)",
     )
     fa.add_argument(
+        "--no-aot", action="store_true",
+        help="spawn every replica with `serve --no-aot`: the fleet-wide "
+        "escape hatch forcing the trace+compile warmup path when a "
+        "published AOT bundle is suspect (docs/AOT.md; scale-out then "
+        "pays the compile wall again)",
+    )
+    fa.add_argument(
         "--replica-journal-dir", default=None,
         help="directory for per-replica journals "
         "(replica_<id>.jsonl each)",
@@ -2136,6 +2187,12 @@ def build_parser() -> argparse.ArgumentParser:
         "exists to prevent)",
     )
     lp.add_argument(
+        "--no-aot", action="store_true",
+        help="publish the promoted model WITHOUT the AOT executable "
+        "bundle (docs/AOT.md; default: export it, so the rolling deploy "
+        "restores executables instead of compiling on every replica)",
+    )
+    lp.add_argument(
         "--timeout", type=float, default=1800.0,
         help="end-to-end rollout timeout (seconds)",
     )
@@ -2283,6 +2340,12 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("import-sklearn", help="legacy pickle → Orbax")
     i.add_argument("--pkl", help="pickle path (default: the reference artifact)")
     i.add_argument("--out", required=True, help="Orbax checkpoint directory")
+    i.add_argument(
+        "--aot", action="store_true",
+        help="also export the AOT executable bundle into the checkpoint "
+        "(docs/AOT.md): replicas serving it restore per-bucket "
+        "executables instead of compiling at warmup",
+    )
     i.set_defaults(fn=cmd_import_sklearn)
     return ap
 

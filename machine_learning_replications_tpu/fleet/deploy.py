@@ -21,7 +21,11 @@ The lifecycle per replica (docs/FLEET.md "Deploy lifecycle"):
      (``serve.server`` — load with integrity verification and the
      last-known-good rollback net, build + warm the new engine off the
      request path, parity-probe, atomic swap). The reply carries the
-     achieved version and whether the restore rolled back.
+     achieved version and whether the restore rolled back. When the
+     target checkpoint ships an AOT executable bundle (docs/AOT.md) the
+     warm step restores serialized executables instead of compiling the
+     ladder, so the per-replica hold window — what paces the whole
+     rollout — is deserialize-scale, not compile-scale.
   4. **Verify + release.** Poll the replica's ``/readyz`` until it
      reports ready AT the achieved version, release the hold, and wait
      for the registry (probe-fed) to rotate it back in before moving on.

@@ -172,6 +172,7 @@ class ReplicaSpec:
         serve_args: tuple[str, ...] | list[str] = (),
         journal_dir: str | None = None,
         python: str = sys.executable,
+        no_aot: bool = False,
     ) -> None:
         self.model = model
         self.register_url = register_url.rstrip("/")
@@ -179,6 +180,12 @@ class ReplicaSpec:
         self.serve_args = tuple(serve_args)
         self.journal_dir = journal_dir
         self.python = python
+        # Fleet-wide AOT escape hatch (docs/AOT.md): force every spawned
+        # replica onto the tracing path — `cli fleet autoscale --no-aot`.
+        # Scale-out reaction time then pays the full ladder compile
+        # again, but a bad published executable bundle cannot touch the
+        # fleet at all.
+        self.no_aot = bool(no_aot)
 
     def command(self, replica_id: str, port: int,
                 model: str | None = None) -> list[str]:
@@ -188,6 +195,7 @@ class ReplicaSpec:
             "--host", self.host, "--port", str(port),
             "--replica-id", replica_id,
             "--register", self.register_url,
+            *(("--no-aot",) if self.no_aot else ()),
             *self.serve_args,
         ]
         if self.journal_dir:

@@ -80,7 +80,8 @@ def is_parked(candidate_dir: str | os.PathLike) -> bool:
 
 
 def publish_candidate(
-    candidate_dir: str | os.PathLike, model_path: str | os.PathLike
+    candidate_dir: str | os.PathLike, model_path: str | os.PathLike,
+    aot: bool = True,
 ) -> int | None:
     """Republish a shadow-approved candidate into the live checkpoint
     path: restore the candidate (integrity-verified) and ``save_model``
@@ -88,7 +89,13 @@ def publish_candidate(
     version into the last-known-good slot and stamps the next monotonic
     version id in the LIVE path's lineage. Returns the published
     version. The candidate dir itself is untouched (it remains the
-    refit's resumable artifact)."""
+    refit's resumable artifact).
+
+    By default the publish also exports the AOT executable bundle
+    (``persist.aot``, docs/AOT.md): promotion IS publish time, and the
+    rolling deploy that follows restores executables instead of paying
+    the ladder compile on every replica — the compile bill is paid once,
+    here, off every replica's hold window."""
     from machine_learning_replications_tpu.persist import orbax_io
 
     candidate_dir = os.path.abspath(os.fspath(candidate_dir))
@@ -98,7 +105,7 @@ def publish_candidate(
             "verdict (REFUSED.json present); refusing to publish it"
         )
     params = orbax_io.load_model(candidate_dir)
-    orbax_io.save_model(model_path, params)
+    orbax_io.save_model(model_path, params, aot=aot)
     version = orbax_io.checkpoint_version(model_path)
     journal.event(
         "learn_candidate_published",
@@ -151,6 +158,7 @@ def promote(
     router_url: str,
     verdict: dict,
     deploy_timeout_s: float = 1800.0,
+    aot: bool = True,
 ) -> dict:
     """The gate, end to end: apply the shadow verdict, then either park
     (fail) or publish + rolling-deploy (pass). Returns
@@ -182,7 +190,7 @@ def promote(
             "candidate": candidate_dir,
             "reasons": verdict.get("reasons"),
         }
-    version = publish_candidate(candidate_dir, model_path)
+    version = publish_candidate(candidate_dir, model_path, aot=aot)
     try:
         report = promote_via_router(
             router_url, model_path, timeout_s=deploy_timeout_s

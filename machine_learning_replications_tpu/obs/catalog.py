@@ -32,6 +32,9 @@ METRICS: dict[str, tuple[str, tuple[str, ...]]] = {
     # -- serve/ --------------------------------------------------------------
     "serve_path_total": ("counter", ("path",)),
     "serve_host_fallback_total": ("counter", ()),
+    "serve_warmup_seconds": ("gauge", ("path", "bucket")),
+    "serve_aot_restore_seconds": ("gauge", ("path", "bucket")),
+    "serve_aot_fallback_total": ("counter", ("reason",)),
     "serve_deploys_total": ("counter", ("result",)),
     "serve_model_version": ("gauge", ()),
     "serve_worker_info": ("gauge", ("worker",)),
@@ -137,6 +140,9 @@ EVENTS: dict[str, tuple[str, ...]] = {
     "deploy_quality_detached": ("path",),
     # -- checkpoints (persist/) ---------------------------------------------
     "checkpoint_publish": ("path", "version"),
+    "aot_export": ("path", "blobs", "seconds"),
+    "aot_restore": ("role", "bucket", "seconds"),
+    "aot_fallback": ("reason",),
     "checkpoint_restore": ("stage",),
     "checkpoint_corrupt": ("stage", "error"),
     "checkpoint_retain_skipped": ("path", "error"),

@@ -1,0 +1,331 @@
+"""AOT-serialized engines — compile at publish time, restore at start time.
+
+Every replica start and every rolling deploy used to pay a full
+trace+compile of the whole bucket ladder (BENCH.md's cold cells: 20–27 s
+fit compiles; serve warmup covers a 7-bucket ladder × dual paths) — the
+single largest fixed cost left in the serving stack, pacing one-at-a-time
+deploy holds, the learn loop's promotion window, and the autoscaler's
+reaction time. This module removes it at the source: the per-bucket
+executables the engine would trace at startup are compiled ONCE at
+checkpoint publish time and shipped *inside* the versioned checkpoint
+tree, so a replica restores executables instead of tracing them
+(docs/AOT.md).
+
+**Artifact layout.** ``export_aot`` writes an ``aot/`` subtree into the
+checkpoint directory being published (it runs inside ``save_model``'s
+atomic ``_publish_tree`` transaction, so the blobs are covered by the
+``integrity.json`` content manifest like every other checkpoint file)::
+
+    <checkpoint>/aot/manifest.json      fingerprints + blob index
+    <checkpoint>/aot/<backend>_b<N>.bin serialized executable, one per
+                                        (backend, bucket)
+
+Each blob is ``jax.experimental.serialize_executable.serialize`` over the
+jit-compiled per-bucket core — the SAME pure function
+(``serve.engine.family_core``) the engine jits at warmup, lowered at the
+same shapes, so a restored executable is *bit-identical* to a traced one
+(asserted by tests/test_aot.py; re-proved at restore time by the engine's
+parity probe against the eager oracle before ``warm`` is set).
+
+**Fingerprints.** A serialized XLA executable is only valid on the
+platform that compiled it. Every backend's blobs carry a fingerprint —
+jax/jaxlib version, backend name, device kind, the x64 flag (the dtype
+regime), and the model family — checked once per restore;
+any mismatch journals a fallback and the engine traces instead.
+
+**Fails open.** Nothing in this module can brick a replica: a missing
+``aot/`` tree, an unreadable manifest, a fingerprint mismatch, a corrupt
+blob, or a deserialization error each journal ``aot_fallback`` (counted
+in ``serve_aot_fallback_total{reason=…}``) and the engine falls back to
+tracing that bucket — the pre-AOT behavior, just slower. ``cli serve
+--no-aot`` (and the fleet passthrough) forces the tracing path outright.
+The ``persist.aot_restore`` faultpoint tears the restore path on demand
+for chaos drills (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+from machine_learning_replications_tpu.resilience import faults
+
+AOT_DIRNAME = "aot"
+_MANIFEST = "manifest.json"
+
+
+def platform_fingerprint(backend: str) -> dict:
+    """The compatibility key a serialized executable is valid under:
+    jax/jaxlib versions, backend name, the concrete device kind, and the
+    x64 flag (which decides every aval dtype the engine compiles at)."""
+    import jax
+    import jaxlib
+
+    try:
+        kind = jax.devices(backend)[0].device_kind
+    except RuntimeError:
+        kind = None
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": backend,
+        "device_kind": kind,
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
+def _fingerprint_diff(want: dict, have: dict) -> str | None:
+    """Human-readable mismatch between a manifest fingerprint and the
+    current platform (None when compatible)."""
+    bad = [
+        f"{k}={have.get(k)!r} (blob built for {want.get(k)!r})"
+        for k in sorted(set(want) | set(have))
+        if want.get(k) != have.get(k)
+    ]
+    return ", ".join(bad) if bad else None
+
+
+def _example_core_inputs(params) -> tuple[str, Any, Any]:
+    """``(family, core_arg, example_row)`` for the per-bucket core: the
+    non-batch argument the engine passes (the ensemble for pipeline
+    checkpoints, the params themselves otherwise) and ONE example row in
+    the core's input space, replicated per bucket at lowering time. Runs
+    the same pre-batch host composition the engine runs
+    (``contract_rows_to_x64`` → ``impute_select``) so the lowered avals
+    equal the served ones exactly."""
+    import jax
+    import numpy as np
+
+    from machine_learning_replications_tpu.data.examples import patient_row
+    from machine_learning_replications_tpu.models import pipeline
+    from machine_learning_replications_tpu.serve.engine import family_core
+
+    family, _core, _n_out = family_core(params)
+    dparams = jax.device_put(params)
+    if family == "pipeline":
+        dparams = dparams.replace(
+            support_mask=np.asarray(params.support_mask)
+        )
+        x64 = pipeline.contract_rows_to_x64(params, patient_row())
+        row = np.asarray(pipeline.impute_select(dparams, x64))
+        return family, dparams.ensemble, row
+    return family, dparams, np.asarray(patient_row(), np.float64)
+
+
+def export_aot(
+    tree_dir: str | os.PathLike,
+    params,
+    device_buckets=None,
+    host_buckets=None,
+) -> dict:
+    """Compile and serialize every bucket's executable into
+    ``<tree_dir>/aot/``. Called inside ``save_model``'s publish
+    transaction (``tree_dir`` is the pre-rename temp tree), so the blobs
+    land in the integrity manifest with everything else.
+
+    Two ladders are exported: the device ladder on the default backend
+    (the engine's buckets) and the host fast-path ladder on the CPU
+    backend (``serve.hostpath``); on a CPU-only deployment they merge
+    into one set of CPU blobs. Returns the written aot manifest."""
+    import jax
+    import numpy as np
+    from jax.experimental import serialize_executable
+
+    from machine_learning_replications_tpu.obs import journal
+    from machine_learning_replications_tpu.persist.atomicio import (
+        fsync_json_dump,
+    )
+    from machine_learning_replications_tpu.serve.engine import (
+        DEFAULT_BUCKETS, family_core,
+    )
+    from machine_learning_replications_tpu.serve.hostpath import (
+        DEFAULT_HOST_BUCKETS,
+    )
+
+    t0 = time.perf_counter()
+    if device_buckets is None:
+        device_buckets = DEFAULT_BUCKETS
+    if host_buckets is None:
+        host_buckets = DEFAULT_HOST_BUCKETS
+    default_backend = jax.default_backend()
+    plan: dict[str, set[int]] = {
+        default_backend: {int(b) for b in device_buckets},
+    }
+    plan.setdefault("cpu", set()).update(int(b) for b in host_buckets)
+
+    family, _core_fn, _n_out = family_core(params)
+    aot_dir = os.path.join(os.fspath(tree_dir), AOT_DIRNAME)
+    os.makedirs(aot_dir, exist_ok=True)
+    blobs: list[dict] = []
+    fingerprints: dict[str, dict] = {}
+    for backend, buckets in sorted(plan.items()):
+        if not buckets:
+            continue
+        device = jax.devices(backend)[0]
+        with jax.default_device(device):
+            fam, core_arg, row = _example_core_inputs(params)
+            _fam, core_fn, _n = family_core(params)
+            jitted = jax.jit(core_fn)
+            for bucket in sorted(buckets):
+                X = np.repeat(row, bucket, axis=0)
+                compiled = jitted.lower(core_arg, X).compile()
+                payload, _in_tree, _out_tree = serialize_executable.serialize(
+                    compiled
+                )
+                name = f"{backend}_b{bucket}.bin"
+                with open(os.path.join(aot_dir, name), "wb") as f:
+                    f.write(payload)
+                blobs.append({
+                    "backend": backend,
+                    "bucket": bucket,
+                    "file": name,
+                    "bytes": len(payload),
+                    "width": int(X.shape[1]),
+                })
+        fingerprints[backend] = platform_fingerprint(backend)
+    seconds = round(time.perf_counter() - t0, 3)
+    manifest = {
+        "format": 1,
+        "family": family,
+        "fingerprints": fingerprints,
+        "blobs": blobs,
+    }
+    fsync_json_dump(os.path.join(aot_dir, _MANIFEST), manifest)
+    journal.event(
+        "aot_export", path=os.fspath(tree_dir), blobs=len(blobs),
+        seconds=seconds,
+    )
+    return manifest
+
+
+def load_bundle(checkpoint_dir: str | os.PathLike) -> "AotBundle | None":
+    """The checkpoint's AOT bundle, or None when it ships none (or its
+    manifest is unreadable — journaled, fails open: the engine simply
+    traces, exactly as it would for a pre-AOT checkpoint)."""
+    from machine_learning_replications_tpu.obs import journal
+
+    path = os.path.join(
+        os.path.abspath(os.fspath(checkpoint_dir)), AOT_DIRNAME
+    )
+    manifest_path = os.path.join(path, _MANIFEST)
+    if not os.path.exists(manifest_path):
+        return None
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != 1:
+            raise ValueError(
+                f"unknown aot manifest format {manifest.get('format')!r}"
+            )
+        manifest["blobs"] = list(manifest["blobs"])
+        dict(manifest["fingerprints"])
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        # The fallback counter lives with its siblings in serve.engine
+        # (one import-time registration site per family); load_bundle's
+        # callers are all serving-side, so the import is already paid.
+        from machine_learning_replications_tpu.serve.engine import (
+            AOT_FALLBACKS,
+        )
+
+        AOT_FALLBACKS.inc(reason="manifest_unreadable")
+        journal.event(
+            "aot_fallback", reason="manifest_unreadable", path=path,
+            detail=f"{type(exc).__name__}: {exc}",
+        )
+        return None
+    return AotBundle(path, manifest)
+
+
+class AotBundle:
+    """A loaded ``aot/`` tree: the manifest plus lazy per-bucket blob
+    access. ``for_backend`` narrows it to the view one engine consumes
+    (the device engine its backend's blobs, the host scorer the CPU
+    ones)."""
+
+    def __init__(self, path: str, manifest: dict) -> None:
+        self.path = path
+        self.manifest = manifest
+
+    @property
+    def family(self) -> str | None:
+        return self.manifest.get("family")
+
+    def for_backend(self, backend: str) -> "AotView":
+        return AotView(self, str(backend))
+
+
+class AotView:
+    """One engine's restore interface (duck-typed by
+    ``serve.engine.BucketedPredictEngine``): fingerprint gate +
+    per-bucket executable loads. All failure modes raise or return None —
+    the ENGINE owns the journaled fails-open fallback policy."""
+
+    def __init__(self, bundle: AotBundle, backend: str) -> None:
+        self._bundle = bundle
+        self.backend = backend
+        self._blobs = {
+            int(b["bucket"]): b
+            for b in bundle.manifest.get("blobs", ())
+            if b.get("backend") == backend
+        }
+
+    def unusable_reason(
+        self, family: str | None = None
+    ) -> tuple[str, str] | None:
+        """Why this view cannot restore anything (None = usable), as a
+        ``(reason_code, detail)`` pair — the code is the bounded
+        ``serve_aot_fallback_total{reason}`` label (missing_backend /
+        family_mismatch / fingerprint_mismatch), the detail is free
+        text for the journal. Checked ONCE per engine warmup."""
+        if not self._blobs:
+            return (
+                "missing_backend",
+                f"no aot blobs for backend {self.backend!r}",
+            )
+        if family is not None and self._bundle.family != family:
+            return (
+                "family_mismatch",
+                f"aot blobs are for family {self._bundle.family!r}, "
+                f"engine serves {family!r}",
+            )
+        want = self._bundle.manifest.get("fingerprints", {}).get(
+            self.backend
+        )
+        if not isinstance(want, dict):
+            return (
+                "fingerprint_mismatch",
+                f"no fingerprint recorded for backend {self.backend!r}",
+            )
+        diff = _fingerprint_diff(want, platform_fingerprint(self.backend))
+        if diff:
+            return (
+                "fingerprint_mismatch",
+                f"platform fingerprint mismatch: {diff}",
+            )
+        return None
+
+    def load_exec(self, bucket: int, in_tree, out_tree):
+        """Deserialize the bucket's executable (None when the manifest
+        has no blob for it). ``in_tree``/``out_tree`` are the call-tree
+        structures the engine reconstructs from its own live params — a
+        structural mismatch fails the load loudly (and the engine falls
+        back to tracing). The ``persist.aot_restore`` faultpoint fires
+        here: raise = a failing restore, corrupt = the blob's bytes torn
+        on disk — both must resolve to a journaled tracing fallback."""
+        from jax.experimental import serialize_executable
+
+        entry = self._blobs.get(int(bucket))
+        if entry is None:
+            return None
+        with open(os.path.join(self._bundle.path, entry["file"]), "rb") as f:
+            payload = f.read()
+        if faults.fire("persist.aot_restore"):
+            payload = (
+                bytes([payload[0] ^ 0xFF]) + payload[1:]
+                if payload else b"\x00"
+            )
+        return serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree
+        )

@@ -399,7 +399,9 @@ def _decode_template(node: Any) -> Any:
     raise ValueError(f"malformed sidecar node: {sorted(node)}")
 
 
-def save_model(path: str | os.PathLike, params: Any) -> None:
+def save_model(
+    path: str | os.PathLike, params: Any, *, aot: bool = False
+) -> None:
     """``save_params`` plus a self-describing sidecar so the checkpoint can
     be restored *without* the caller reconstructing a template pytree (the
     CLI's load path). The sidecar is JSON: the params' dataclass structure
@@ -410,7 +412,13 @@ def save_model(path: str | os.PathLike, params: Any) -> None:
     tree, one rename): its existence is the durability marker
     (``StageCheckpointer.completed``), and it is covered by the integrity
     manifest, so a present sidecar implies a complete, checksummed
-    checkpoint."""
+    checkpoint.
+
+    ``aot=True`` additionally compiles and serializes every serving
+    bucket's executable into the same publish (``persist.aot``,
+    docs/AOT.md): the replicas that restore this checkpoint load
+    executables instead of tracing them. The export pays the full ladder
+    compile bill HERE, once, at publish time — which is the point."""
     from machine_learning_replications_tpu.persist.atomicio import (
         fsync_json_dump,
     )
@@ -421,6 +429,12 @@ def save_model(path: str | os.PathLike, params: Any) -> None:
             os.path.join(tmp, _TEMPLATE_FILE),
             {"format": 1, "root": _encode_template(params)},
         )
+        if aot:
+            from machine_learning_replications_tpu.persist import (
+                aot as aot_mod,
+            )
+
+            aot_mod.export_aot(tmp, params)
 
     _publish_tree(os.path.abspath(os.fspath(path)), write_tree)
 

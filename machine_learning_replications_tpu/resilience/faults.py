@@ -34,6 +34,11 @@ docs/RESILIENCE.md table — in exact agreement:
   persist.restore     ``persist/orbax_io.py`` restore entry           raise delay
                       (corrupt = flip bytes on disk so integrity     corrupt
                       verification must catch it)
+  persist.aot_restore ``persist/aot.py`` per-bucket AOT executable   raise delay
+                      load (raise = a failing restore; corrupt =     corrupt
+                      the blob's bytes torn before deserialization
+                      — both must resolve to the engine's journaled
+                      fails-open fallback to tracing, docs/AOT.md)
   lifecycle.spawn     ``fleet/lifecycle.py`` replica spawn entry     raise delay
                       (raise = the spawn attempt itself fails;       corrupt
                       corrupt = the manager launches a replica that
@@ -100,6 +105,7 @@ SITES: dict[str, tuple[str, ...]] = {
     "engine.warmup": ("raise", "delay"),
     "persist.save": ("raise", "delay", "corrupt"),
     "persist.restore": ("raise", "delay", "corrupt"),
+    "persist.aot_restore": ("raise", "delay", "corrupt"),
     "lifecycle.spawn": ("raise", "delay", "corrupt"),
     "lifecycle.drain": ("raise", "delay", "corrupt"),
 }

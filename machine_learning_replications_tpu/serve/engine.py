@@ -54,10 +54,52 @@ from typing import Sequence
 
 import numpy as np
 
-from machine_learning_replications_tpu.obs import jaxmon, journal, spans
+from machine_learning_replications_tpu.obs import jaxmon, journal
+from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.resilience import faults
 
 DEFAULT_BUCKETS = (1, 8, 32, 64, 128, 256, 512)
+
+#: Per-bucket warmup wall seconds, per scoring path (device engine vs the
+#: host fast-path scorer). Set every warmup — whether the bucket compiled
+#: or restored an AOT executable — so the deploy controller and the
+#: autoscaler can read warmup cost off a scrape instead of parsing
+#: stderr (the old ad-hoc ``say`` prints).
+WARMUP_SECONDS = REGISTRY.gauge(
+    "serve_warmup_seconds",
+    "Engine warmup wall seconds per bucket (labels: path=device|host, "
+    "bucket).",
+    labels=("path", "bucket"),
+)
+#: Per-bucket AOT executable restore wall seconds (deserialize+load of
+#: the published blob — the cost that replaces the bucket's XLA compile
+#: when a checkpoint ships AOT artifacts, docs/AOT.md).
+AOT_RESTORE_SECONDS = REGISTRY.gauge(
+    "serve_aot_restore_seconds",
+    "AOT executable restore wall seconds per bucket (labels: "
+    "path=device|host, bucket).",
+    labels=("path", "bucket"),
+)
+#: AOT restore failures that fell open to tracing, by reason. An entry
+#: here is a replica that started CORRECTLY but slowly — the fails-open
+#: contract (docs/AOT.md "Fallback semantics").
+AOT_FALLBACKS = REGISTRY.counter(
+    "serve_aot_fallback_total",
+    "AOT restore failures that fell back to tracing, by reason "
+    "(fingerprint_mismatch, missing_backend, family_mismatch, "
+    "missing_bucket, deserialize_error, exec_error, parity_mismatch, "
+    "manifest_unreadable).",
+    labels=("reason",),
+)
+# Every documented reason gets a zero-baseline series at import: an
+# alert over any of them must distinguish "never happened" (explicit 0)
+# from a scrape that simply predates the first firing.
+for _reason in (
+    "fingerprint_mismatch", "missing_backend", "family_mismatch",
+    "missing_bucket", "deserialize_error", "exec_error",
+    "parity_mismatch", "manifest_unreadable",
+):
+    AOT_FALLBACKS.labels(reason=_reason)
 
 #: Extra-dispatch cost of one more sub-batch, in padded-row equivalents:
 #: a single-row engine call measured ~2.1 ms on the r11 bench CPU while
@@ -96,6 +138,66 @@ def _tail_plan(
     return best_plan
 
 
+def family_core(params):
+    """``(family, core, n_outputs)`` — the pure per-family jit core the
+    engine compiles once per bucket: ``core(arg, X)`` where ``arg`` is
+    the ensemble for pipeline checkpoints and the params pytree
+    otherwise. The AOT exporter (``persist.aot``) lowers exactly THIS
+    function at the engine's shapes, so a published executable is
+    bit-identical to the one warmup would trace."""
+    from machine_learning_replications_tpu.models import (
+        pipeline, stacking, tree,
+    )
+
+    if isinstance(params, pipeline.PipelineParams):
+        return (
+            "pipeline",
+            lambda ens, X: stacking.predict_proba1_with_members(ens, X),
+            2,
+        )
+    if isinstance(params, tree.TreeEnsembleParams):
+        return "tree", lambda p, X: tree.predict_proba1(p, X), 1
+    if isinstance(params, stacking.StackingParams):
+        return (
+            "stacking",
+            lambda p, X: stacking.predict_proba1_with_members(p, X),
+            2,
+        )
+    raise TypeError(
+        f"cannot serve params of type {type(params).__name__}; "
+        "expected PipelineParams, TreeEnsembleParams, or StackingParams"
+    )
+
+
+def oracle_proba1(params, rows) -> np.ndarray:
+    """The eager single-request composition — the exact route
+    ``cli predict`` takes — as the parity oracle for deploy candidates
+    (``serve.server._verify_parity``) and AOT-restored executables
+    (``BucketedPredictEngine.warmup``)."""
+    from machine_learning_replications_tpu.models import (
+        pipeline, stacking, tree,
+    )
+
+    if isinstance(params, pipeline.PipelineParams):
+        out = pipeline.pipeline_predict_proba1_contract(params, rows)
+    elif isinstance(params, tree.TreeEnsembleParams):
+        out = tree.predict_proba1(params, rows)
+    else:
+        out = stacking.predict_proba1(params, rows)
+    return np.asarray(out, np.float64)
+
+
+def parity_tolerance() -> tuple[float, float]:
+    """``(rtol, atol)`` for engine-vs-eager-oracle parity: XLA fusion may
+    regroup float ops vs op-by-op dispatch, so the bound is
+    precision-dependent — 1e-12 relative under x64 (the serve parity
+    suite's documented bound), 1e-5 under default float32 (fusion noise
+    ~1e-7 relative there; wrong weights differ at 1e-1)."""
+    import jax
+
+    return (1e-12, 1e-15) if jax.config.jax_enable_x64 else (1e-5, 1e-8)
+
+
 class BucketedPredictEngine:
     """Compiled batched predict with a bounded, warm bucket ladder.
 
@@ -103,6 +205,16 @@ class BucketedPredictEngine:
     core was *traced* at that size (tracing happens exactly once per XLA
     compile), so tests can assert the compile-cache bound directly instead
     of inferring it from timing.
+
+    ``aot`` (a ``persist.aot.AotView``, docs/AOT.md) lets ``warmup``
+    restore published per-bucket executables instead of tracing them —
+    the compile wall becomes a deserialize. Restores are journaled and
+    fail OPEN: any per-bucket failure (fingerprint mismatch, corrupt
+    blob, a restored executable that disagrees with the eager oracle)
+    falls back to tracing that bucket, so a bad artifact can cost time,
+    never correctness or availability. ``aot_role`` labels the engine's
+    telemetry (``device`` for the batch engine, ``host`` for the
+    fast-path scorer).
     """
 
     def __init__(
@@ -112,8 +224,11 @@ class BucketedPredictEngine:
         quality=None,
         split_penalty_rows: int = DEFAULT_SPLIT_PENALTY_ROWS,
         max_split: int = DEFAULT_MAX_SPLIT,
+        aot=None,
+        aot_role: str = "device",
     ) -> None:
         import jax
+        import jax.tree_util as jtu
 
         from machine_learning_replications_tpu.models import (
             pipeline, stacking, tree,
@@ -133,6 +248,12 @@ class BucketedPredictEngine:
         self.trace_counts: dict[int, int] = {}
         self.warm = False
         self.n_features = 17  # the predict_hf.py:5-27 contract width
+        self.aot = aot
+        self.aot_role = str(aot_role)
+        # bucket -> AOT-restored executable; populated by warmup, read by
+        # _run_core on every call (a bucket not in here runs the jitted
+        # trace path — the two are bit-identical by the export contract).
+        self._aot_execs: dict[int, object] = {}
         # obs.quality.QualityMonitor (or None): every predict() feeds it
         # the batch's REAL rows in the model's input space — post-impute
         # post-select for the pipeline route, the contract rows themselves
@@ -141,19 +262,7 @@ class BucketedPredictEngine:
         # the drift window.
         self.quality = quality
 
-        if not isinstance(
-            params,
-            (
-                pipeline.PipelineParams,
-                tree.TreeEnsembleParams,
-                stacking.StackingParams,
-            ),
-        ):
-            raise TypeError(
-                f"cannot serve params of type {type(params).__name__}; "
-                "expected PipelineParams, TreeEnsembleParams, or "
-                "StackingParams"
-            )
+        self.family, base_core, n_out = family_core(params)
         # Params ride as jit ARGUMENTS (not closure constants — numpy
         # constants cannot be fancy-indexed by tracers inside the staged
         # program), device_put ONCE here so the ensemble is not re-uploaded
@@ -162,6 +271,14 @@ class BucketedPredictEngine:
         # shape — one compile per bucket. The obs wrapper accounts the
         # upload's bytes (jax_transfer_bytes_total{direction="h2d"}).
         dparams = jaxmon.device_put(params)
+
+        def core(a, X):
+            # Executes at trace time only; AOT-restored executables never
+            # trace, so trace_counts stays a pure compile count.
+            self._note_trace(int(X.shape[0]))
+            return base_core(a, X)
+
+        self._jit_core = jax.jit(core)
         if isinstance(params, pipeline.PipelineParams):
             # ... except the support mask, which stays host-resident:
             # impute_select np.where's it per call, and a device mask
@@ -183,11 +300,7 @@ class BucketedPredictEngine:
             # member meta-features: they are intermediates of the blended
             # probability anyway, and the quality monitor's ensemble-
             # agreement signal needs them per batch.
-            def core(ens, X17sel):
-                self._note_trace(int(X17sel.shape[0]))
-                return stacking.predict_proba1_with_members(ens, X17sel)
-
-            jit_core = jax.jit(core)
+            core_arg = dparams.ensemble
 
             def impl(X17: np.ndarray):
                 x64 = pipeline.contract_rows_to_x64(params, X17)
@@ -197,7 +310,7 @@ class BucketedPredictEngine:
                 # per-call resolution rather than serve an unimputed NaN.
                 fn = None if np.isnan(X17).any() else contract_block_fn
                 X17sel = pipeline.impute_select(dparams, x64, block_fn=fn)
-                p1, members = jit_core(dparams.ensemble, X17sel)
+                p1, members = self._run_core(X17sel)
                 # The quality rows are the POST-impute post-select matrix —
                 # the space the reference profile was built over.
                 return p1, members, X17sel
@@ -205,29 +318,41 @@ class BucketedPredictEngine:
         elif isinstance(params, tree.TreeEnsembleParams):
             # Bare GBDT (`sweep --save`): one jitted call, no member
             # outputs to disagree over.
-            def core(p, X):
-                self._note_trace(int(X.shape[0]))
-                return tree.predict_proba1(p, X)
-
-            jit_core = jax.jit(core)
+            core_arg = dparams
 
             def impl(X):
-                return jit_core(dparams, X), None, X
+                return self._run_core(X), None, X
 
         else:
             # stacking.StackingParams: rows are already the member
             # ensemble's 17-column input.
-            def core(p, X):
-                self._note_trace(int(X.shape[0]))
-                return stacking.predict_proba1_with_members(p, X)
-
-            jit_core = jax.jit(core)
+            core_arg = dparams
 
             def impl(X):
-                p1, members = jit_core(dparams, X)
+                p1, members = self._run_core(X)
                 return p1, members, X
 
         self._impl = impl
+        self._core_arg = core_arg
+        # Call-tree templates for AOT executable restore: structure only
+        # (shapes are per-blob), reconstructed from the LIVE params so a
+        # serialized executable can only load against a structurally
+        # matching checkpoint (persist.aot.AotView.load_exec).
+        self._aot_in_tree = jtu.tree_structure(
+            ((core_arg, np.zeros(1)), {})
+        )
+        self._aot_out_tree = jtu.tree_structure(
+            (np.zeros(1), np.zeros(1)) if n_out == 2 else np.zeros(1)
+        )
+
+    def _run_core(self, X):
+        """One per-bucket core call: the AOT-restored executable when the
+        batch's bucket has one, the jitted trace path otherwise — the two
+        are bit-identical by the export contract (docs/AOT.md)."""
+        fn = self._aot_execs.get(int(X.shape[0]))
+        if fn is not None:
+            return fn(self._core_arg, X)
+        return self._jit_core(self._core_arg, X)
 
     def _note_trace(self, rows: int) -> None:
         # Executes at trace time only (the body is staged out afterwards),
@@ -352,9 +477,20 @@ class BucketedPredictEngine:
         return probs
 
     def warmup(self, say=None) -> dict[int, float]:
-        """Compile every ladder bucket up front (example-patient rows, each
-        blocked to completion); returns per-bucket wall seconds. After
-        warmup, steady-state traffic never waits on a compile."""
+        """Make every ladder bucket hot up front (example-patient rows,
+        each blocked to completion); returns per-bucket wall seconds.
+        After warmup, steady-state traffic never waits on a compile.
+
+        With an ``aot`` view attached, published executables restore
+        FIRST (``docs/AOT.md``): each bucket then runs the deserialized
+        program instead of tracing, and its first output is probed
+        against the eager oracle (``oracle_proba1``) before the engine
+        may be marked warm — a restored executable that cannot reproduce
+        the oracle is discarded, journaled (``aot_fallback``), and the
+        bucket re-traces. Per-bucket timings flow through the shared
+        ``journal.stage_scope`` path and the ``serve_warmup_seconds`` /
+        ``serve_aot_restore_seconds`` gauges (``say`` is kept for
+        interface compatibility; timing no longer prints through it)."""
         import jax
 
         from machine_learning_replications_tpu.data.examples import patient_row
@@ -363,15 +499,112 @@ class BucketedPredictEngine:
         # (the factory re-warms), exercising the bounded-backoff retry.
         faults.fire("engine.warmup")
         row = patient_row()
+        if self.aot is not None and not self._aot_execs:
+            self._restore_aot()
+        oracle_p = (
+            float(oracle_proba1(self.params, row)[0])
+            if self._aot_execs else None
+        )
         times: dict[int, float] = {}
         for b in self.buckets:
-            t0 = time.monotonic()
-            with spans.span("serve:warmup", bucket=b):
-                jax.block_until_ready(
-                    self._impl(np.repeat(row, b, axis=0))
-                )
-            times[b] = time.monotonic() - t0
-            if say is not None:
-                say(f"warmup bucket {b}: {times[b]:.2f}s")
+            times[b] = self._warm_bucket(jax, b, row, oracle_p)
         self.warm = True
         return times
+
+    def _restore_aot(self) -> None:
+        """Load the bundle's per-bucket executables (fails open per
+        bucket: a failed load journals + counts a fallback and leaves the
+        bucket on the trace path). The bundle-level gate — platform
+        fingerprint, model family, backend coverage — runs once."""
+        try:
+            bad = self.aot.unusable_reason(self.family)
+        except Exception as exc:  # a torn manifest must not kill warmup
+            bad = (
+                "manifest_unreadable", f"{type(exc).__name__}: {exc}",
+            )
+        if bad is not None:
+            # (code, detail) from AotView; a bare string from a legacy
+            # view reads as the platform-skew bucket.
+            code, detail = (
+                bad if isinstance(bad, tuple)
+                else ("fingerprint_mismatch", bad)
+            )
+            self._aot_fallback(code, detail=detail)
+            return
+        for b in self.buckets:
+            t0 = time.monotonic()
+            try:
+                fn = self.aot.load_exec(
+                    b, self._aot_in_tree, self._aot_out_tree
+                )
+            except Exception as exc:
+                self._aot_fallback(
+                    "deserialize_error", bucket=b,
+                    detail=f"{type(exc).__name__}: {exc}",
+                )
+                continue
+            if fn is None:
+                self._aot_fallback("missing_bucket", bucket=b)
+                continue
+            dt = time.monotonic() - t0
+            self._aot_execs[b] = fn
+            AOT_RESTORE_SECONDS.set(dt, path=self.aot_role, bucket=str(b))
+            journal.event(
+                "aot_restore", role=self.aot_role, bucket=b,
+                seconds=round(dt, 4),
+            )
+
+    def _aot_fallback(self, reason: str, bucket=None, detail=None) -> None:
+        # Journal key is `role` (device|host), deliberately NOT `path`:
+        # persist.aot's emits carry `path` as a filesystem path, and one
+        # journal key must not mean two things across emit sites.
+        AOT_FALLBACKS.inc(reason=reason)
+        journal.event(
+            "aot_fallback", reason=reason, role=self.aot_role,
+            bucket=bucket, detail=detail,
+        )
+
+    def _warm_bucket(self, jax, b: int, row, oracle_p) -> float:
+        """One bucket's warmup pass: run + block the impl (AOT executable
+        or trace+compile), verify an AOT bucket against the oracle, and
+        re-trace on any AOT failure. Returns the bucket's total warmup
+        wall seconds (fallback re-trace included — the honest cost)."""
+        X = np.repeat(row, b, axis=0)
+        via_aot = b in self._aot_execs
+        t0 = time.monotonic()
+        out = None
+        with journal.stage_scope(f"serve_warmup:{self.aot_role}:b{b}"):
+            try:
+                out = self._impl(X)
+                jax.block_until_ready(out)
+            except Exception:
+                if not via_aot:
+                    raise  # a trace-path failure is a real engine failure
+                out = None
+        fallback = None
+        if via_aot:
+            if out is None:
+                fallback = "exec_error"
+            else:
+                # Whole-vector check: the warmup rows are b copies of one
+                # patient, so EVERY output lane must equal the oracle — a
+                # blob miscompiled past lane 0 must not slip through a
+                # row-0-only probe.
+                rtol, atol = parity_tolerance()
+                p1 = np.asarray(out[0], np.float64)
+                if p1.shape != (b,) or not np.allclose(
+                    p1, oracle_p, rtol=rtol, atol=atol
+                ):
+                    fallback = "parity_mismatch"
+        if fallback is not None:
+            # Fails open: drop the bad executable, journal, re-trace the
+            # bucket — slower start, never a wrong (or absent) answer.
+            self._aot_fallback(fallback, bucket=b)
+            del self._aot_execs[b]
+            with journal.stage_scope(
+                f"serve_warmup:{self.aot_role}:b{b}:retrace"
+            ):
+                jax.block_until_ready(self._impl(X))
+        dt = time.monotonic() - t0
+        WARMUP_SECONDS.set(dt, path=self.aot_role, bucket=str(b))
+        return dt

@@ -98,13 +98,19 @@ class HostScorer:
         params,
         buckets=DEFAULT_HOST_BUCKETS,
         quality=None,
+        aot=None,
     ) -> None:
         import jax
 
         self._cpu = jax.devices("cpu")[0]
         with jax.default_device(self._cpu):
+            # ``aot`` is the checkpoint bundle's CPU-backend view
+            # (persist.aot, docs/AOT.md): the fast path's tiny ladder
+            # restores published executables instead of tracing, same
+            # fails-open fallback as the device engine.
             self._engine = BucketedPredictEngine(
-                params, buckets=buckets, quality=quality
+                params, buckets=buckets, quality=quality,
+                aot=aot, aot_role="host",
             )
 
     @property

@@ -217,6 +217,7 @@ class ServerHandle:
         host=None, router=None, quality_feed=None,
         model_version: int | None = None, replica_id: str | None = None,
         admin_enabled: bool = False, live=None, say=None,
+        use_aot: bool = True,
     ) -> None:
         self.engine = engine
         self.batcher = batcher
@@ -237,6 +238,11 @@ class ServerHandle:
         self.model_version = model_version
         self.replica_id = replica_id
         self.admin_enabled = admin_enabled  # /admin/deploy opt-in
+        # AOT restore policy (docs/AOT.md): when False (cli serve
+        # --no-aot) deploys ignore published executable bundles and
+        # always trace — the operator escape hatch that guarantees a bad
+        # serialized artifact can never brick a fleet.
+        self.use_aot = use_aot
         # The live-params holder the supervised-restart factory reads
         # through (make_server) — deploys update it so a post-deploy
         # restart rebuilds the CURRENT model, not the boot-time one.
@@ -360,6 +366,18 @@ class ServerHandle:
                 state="warming", to_version=info["version"],
                 rolled_back=info["rolled_back"],
             )
+            # AOT executable restore (docs/AOT.md): the bundle comes from
+            # the directory that ACTUALLY restored (a rollback serves the
+            # lastgood's blobs, never the corrupt target's). The whole
+            # deploy hold — build + warm + parity below — collapses from
+            # a ladder of compiles to a ladder of deserializes.
+            aot_bundle = None
+            if self.use_aot:
+                from machine_learning_replications_tpu.persist import (
+                    aot as aot_mod,
+                )
+
+                aot_bundle = aot_mod.load_bundle(info["path"])
             engine_buckets = self.engine.buckets
             # The new engine keeps feeding the SAME quality monitor only
             # when the input space is unchanged; a different family (or
@@ -386,8 +404,14 @@ class ServerHandle:
                     )
 
             def factory():
+                import jax
+
                 eng = BucketedPredictEngine(
-                    params, buckets=engine_buckets, quality=quality
+                    params, buckets=engine_buckets, quality=quality,
+                    aot=(
+                        aot_bundle.for_backend(jax.default_backend())
+                        if aot_bundle is not None else None
+                    ),
                 )
                 # The version tags the engine (not just handle state) so
                 # replies name the version of the bits they carry even
@@ -403,6 +427,10 @@ class ServerHandle:
                 new_scorer = HostScorer(
                     params, buckets=self.host.scorer.buckets,
                     quality=quality,
+                    aot=(
+                        aot_bundle.for_backend("cpu")
+                        if aot_bundle is not None else None
+                    ),
                 )
                 new_scorer.model_version = info["version"]
                 new_scorer.warmup(say=self._say)
@@ -497,20 +525,11 @@ def _same_input_space(old_params, new_params) -> bool:
 
 def _oracle_probs(params, rows):
     """The eager single-request composition — the exact route
-    ``cli predict`` takes — as the deploy parity oracle."""
-    import numpy as np
+    ``cli predict`` takes — as the deploy parity oracle (shared with the
+    engine's AOT restore probe: ``serve.engine.oracle_proba1``)."""
+    from machine_learning_replications_tpu.serve.engine import oracle_proba1
 
-    from machine_learning_replications_tpu.models import (
-        pipeline, stacking, tree,
-    )
-
-    if isinstance(params, pipeline.PipelineParams):
-        out = pipeline.pipeline_predict_proba1_contract(params, rows)
-    elif isinstance(params, tree.TreeEnsembleParams):
-        out = tree.predict_proba1(params, rows)
-    else:
-        out = stacking.predict_proba1(params, rows)
-    return np.asarray(out, np.float64)
+    return oracle_proba1(params, rows)
 
 
 def _verify_parity(params, engine, scorer=None, n_rows: int = 4) -> None:
@@ -524,10 +543,12 @@ def _verify_parity(params, engine, scorer=None, n_rows: int = 4) -> None:
     agree with EACH OTHER bit-for-bit on the single-row program, before
     the candidate may swap into rotation. A miscompiled or
     wrong-weights candidate can never serve a single wrong answer."""
-    import jax
     import numpy as np
 
     from machine_learning_replications_tpu.data.examples import patient_row
+    from machine_learning_replications_tpu.serve.engine import (
+        parity_tolerance,
+    )
 
     base = np.asarray(patient_row(), np.float64)
     rng = np.random.default_rng(0)
@@ -538,9 +559,7 @@ def _verify_parity(params, engine, scorer=None, n_rows: int = 4) -> None:
         ],
         axis=0,
     )
-    rtol, atol = (
-        (1e-12, 1e-15) if jax.config.jax_enable_x64 else (1e-5, 1e-8)
-    )
+    rtol, atol = parity_tolerance()
     want = _oracle_probs(params, rows)
     got = np.asarray(engine.predict(rows), np.float64)
     if not np.allclose(got, want, rtol=rtol, atol=atol):
@@ -1273,6 +1292,8 @@ def make_server(
     model_version: int | None = None,
     replica_id: str | None = None,
     admin_endpoint: bool = False,
+    aot_bundle=None,
+    use_aot: bool = True,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
@@ -1350,6 +1371,14 @@ def make_server(
     ``/admin/deploy`` warm-swap endpoint (``ServerHandle.deploy_model``)
     — off by default for the same reason ``/debug/faults`` is.
 
+    AOT restore (docs/AOT.md): ``aot_bundle`` is the served checkpoint's
+    published executable bundle (``persist.aot.load_bundle``) — warmup
+    then deserializes per-bucket executables instead of tracing them,
+    with a journaled fails-open fallback per bucket. ``use_aot=False``
+    (``cli serve --no-aot``) ignores bundles everywhere, including later
+    ``/admin/deploy`` swaps — the escape hatch that guarantees a bad
+    serialized artifact can never brick a fleet.
+
     The listener BINDS before warmup runs: a port conflict fails in
     milliseconds instead of after the multi-second compile bill. Warmup
     still completes before this returns (warm standby — the first served
@@ -1419,8 +1448,16 @@ def make_server(
         engine_quality = quality_feed
     if fault_endpoint:
         faults.enable_endpoint()
+    if not use_aot:
+        aot_bundle = None
+    device_aot = host_aot = None
+    if aot_bundle is not None:
+        import jax
+
+        device_aot = aot_bundle.for_backend(jax.default_backend())
+        host_aot = aot_bundle.for_backend("cpu")
     engine = BucketedPredictEngine(
-        params, buckets=buckets, quality=engine_quality
+        params, buckets=buckets, quality=engine_quality, aot=device_aot
     )
     # Fleet identity rides ON the computing engine, not just the handle:
     # around a warm swap (/admin/deploy), in-flight flushes finish on the
@@ -1435,9 +1472,13 @@ def make_server(
             # Restart path (supervisor thread, off the request path):
             # fresh jit cache, ALWAYS re-warmed — a restarted engine that
             # made the first post-recovery requests pay the compile bill
-            # would turn recovery into a tail-latency incident.
+            # would turn recovery into a tail-latency incident. With an
+            # AOT bundle the rebuild restores executables too, so the
+            # breaker's rebuild-after-wedge window shrinks the same way
+            # cold start does.
             eng = BucketedPredictEngine(
-                params, buckets=engine_buckets, quality=engine_quality
+                params, buckets=engine_buckets, quality=engine_quality,
+                aot=device_aot,
             )
             eng.model_version = model_version
             eng.warmup(say=say)
@@ -1470,7 +1511,8 @@ def make_server(
     host_pool = router = None
     if host_path:
         scorer = HostScorer(
-            params, buckets=host_buckets, quality=engine_quality
+            params, buckets=host_buckets, quality=engine_quality,
+            aot=host_aot,
         )
         scorer.model_version = model_version
         host_pool = HostPath(scorer, workers=host_workers, metrics=metrics)
@@ -1502,6 +1544,7 @@ def make_server(
         host=host_pool, router=router, quality_feed=quality_feed,
         model_version=model_version, replica_id=replica_id,
         admin_enabled=admin_endpoint, live={"params": params}, say=say,
+        use_aot=use_aot,
     )
     app = _App(handle, request_timeout_s, quiet)
     try:

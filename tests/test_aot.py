@@ -1,0 +1,593 @@
+"""AOT-serialized engines (persist/aot.py + the engine restore path,
+docs/AOT.md): publish-time export, restore-instead-of-trace warmup,
+bit-identical AOT-vs-traced outputs, the fingerprint/corruption/parity
+fails-open fallbacks, the integrity-manifest round trip, the
+``persist.aot_restore`` faultpoint, and the coldstart bench's --tiny
+smoke (ISSUE 15 acceptance: the restore path exercised on every CI run).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from machine_learning_replications_tpu.obs import journal
+from machine_learning_replications_tpu.persist import aot, orbax_io
+from machine_learning_replications_tpu.resilience import faults
+from machine_learning_replications_tpu.serve.engine import (
+    BucketedPredictEngine,
+)
+
+BUCKETS = (1, 8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    """A small live sklearn-fitted stacking ensemble (the import route,
+    available everywhere — same shape as the serve suite's fixture)."""
+    from sklearn.ensemble import (
+        GradientBoostingClassifier, StackingClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import make_pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.svm import SVC
+
+    from machine_learning_replications_tpu.persist import import_stacking
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(160, 17))
+    y = (X @ rng.normal(size=17) > 0).astype(float)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = StackingClassifier(
+            estimators=[
+                ("svc", make_pipeline(
+                    StandardScaler(), SVC(probability=True, random_state=0),
+                )),
+                ("gbc", GradientBoostingClassifier(
+                    n_estimators=5, max_depth=1, random_state=0)),
+                ("lg", LogisticRegression()),
+            ],
+            final_estimator=LogisticRegression(),
+        ).fit(X, y)
+    return import_stacking(clf)
+
+
+@pytest.fixture(scope="module")
+def other_params(params):
+    """The same model with perturbed meta weights: IDENTICAL shapes (so
+    its executables load and run against ``params``), different bits —
+    wrong-weights material for the parity-mismatch guard."""
+    from machine_learning_replications_tpu.models import linear
+
+    return params.replace(
+        meta=linear.LinearParams(
+            coef=np.asarray(params.meta.coef) * 1.5 + 0.25,
+            intercept=np.asarray(params.meta.intercept) - 0.5,
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def ckpt(params, tmp_path_factory):
+    """One published checkpoint WITH its AOT bundle, restored once — the
+    (restored params, bundle, path) triple most tests consume."""
+    path = str(tmp_path_factory.mktemp("aot") / "model")
+    orbax_io.save_model(path, params, aot=True)
+    restored = orbax_io.load_model(path)
+    return restored, aot.load_bundle(path), path
+
+
+@pytest.fixture()
+def captured_journal(tmp_path):
+    path = tmp_path / "journal.jsonl"
+    jrn = journal.RunJournal(path, command="test")
+    journal.set_journal(jrn)
+    try:
+        yield path
+    finally:
+        journal.set_journal(None)
+        jrn.close()
+
+
+def _events(path) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _query_rows(n: int = 70) -> np.ndarray:
+    base = np.asarray(
+        [[63, 1, 1, 1, 0, 0, 0, 1, 0, 1, 0, 145, 1, 20, 1.2, 38, 140]],
+        np.float64,
+    )
+    return np.repeat(base, n, axis=0) * (
+        1.0 + 0.001 * np.arange(n)[:, None]
+    )
+
+
+# -- export / publish --------------------------------------------------------
+
+
+def test_publish_with_aot_integrity_roundtrip(ckpt):
+    """The AOT blobs are ordinary checkpoint payload: covered by
+    integrity.json, deep-verified, and the aot manifest indexes exactly
+    the blob files on disk."""
+    _params, bundle, path = ckpt
+    assert orbax_io.verify_checkpoint(path, deep=True)
+    integrity = json.load(open(os.path.join(path, "integrity.json")))
+    aot_files = sorted(
+        k for k in integrity["files"] if k.startswith("aot/")
+    )
+    assert "aot/manifest.json" in aot_files
+    blobs = bundle.manifest["blobs"]
+    assert sorted(f"aot/{b['file']}" for b in blobs) == sorted(
+        f for f in aot_files if f.endswith(".bin")
+    )
+    # The default export covers the device ladder ∪ host ladder on CPU.
+    from machine_learning_replications_tpu.serve.engine import (
+        DEFAULT_BUCKETS,
+    )
+
+    assert {b["bucket"] for b in blobs} == set(DEFAULT_BUCKETS)
+    assert all(b["backend"] == "cpu" for b in blobs)
+    assert bundle.family == "stacking"  # the family_core kind
+
+
+def test_corrupting_a_blob_fails_deep_verification(params, tmp_path):
+    """Post-publish blob rot is caught where all checkpoint rot is:
+    integrity verification, BEFORE anything deserializes it."""
+    path = str(tmp_path / "model")
+    orbax_io.save_model(path, params, aot=True)
+    blob = next(
+        os.path.join(path, "aot", f)
+        for f in sorted(os.listdir(os.path.join(path, "aot")))
+        if f.endswith(".bin")
+    )
+    with open(blob, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]))
+    with pytest.raises(orbax_io.CheckpointIntegrityError):
+        orbax_io.verify_checkpoint(path, deep=True)
+
+
+# -- restore: the happy path -------------------------------------------------
+
+
+def test_aot_restore_bit_identical_and_compile_free(ckpt, captured_journal):
+    """The tentpole contract: an AOT-restored engine compiles NOTHING at
+    warmup and serves bit-identical probabilities to a traced engine —
+    per bucket, across split plans, on the same restored params."""
+    params, bundle, _path = ckpt
+    traced = BucketedPredictEngine(params, buckets=BUCKETS)
+    traced.warmup()
+    restored = BucketedPredictEngine(
+        params, buckets=BUCKETS, aot=bundle.for_backend("cpu")
+    )
+    restored.warmup()
+    assert restored.compile_count() == 0, restored.trace_counts
+    assert traced.compile_count() == len(BUCKETS)
+    assert sorted(restored._aot_execs) == sorted(BUCKETS)
+    rows = _query_rows(70)  # plans across 1/8 incl. padding + splits
+    for n in (1, 3, 8, 70):
+        a = traced.predict(rows[:n])
+        b = restored.predict(rows[:n])
+        assert (a == b).all(), f"bit mismatch at n={n}"
+    kinds = [e["kind"] for e in _events(captured_journal)]
+    assert kinds.count("aot_restore") == len(BUCKETS)
+    assert "aot_fallback" not in kinds
+
+
+def test_host_scorer_restores_from_cpu_view(ckpt):
+    from machine_learning_replications_tpu.serve.hostpath import HostScorer
+
+    params, bundle, _path = ckpt
+    scorer = HostScorer(
+        params, buckets=(1, 8), aot=bundle.for_backend("cpu")
+    )
+    scorer.warmup()
+    assert scorer._engine.compile_count() == 0
+    traced = HostScorer(params, buckets=(1, 8))
+    traced.warmup()
+    row = _query_rows(1)
+    assert float(scorer.predict(row)[0]) == float(traced.predict(row)[0])
+
+
+def test_missing_bucket_falls_back_to_trace_for_that_bucket_only(
+    ckpt, captured_journal
+):
+    """A ladder bucket the bundle never exported (here: 13 is not a
+    default-ladder bucket) traces; the covered buckets still restore."""
+    params, bundle, _path = ckpt
+    eng = BucketedPredictEngine(
+        params, buckets=(1, 8, 13), aot=bundle.for_backend("cpu")
+    )
+    eng.warmup()
+    assert sorted(eng._aot_execs) == [1, 8]
+    assert eng.compile_count() == 1  # bucket 13 traced
+    events = _events(captured_journal)
+    fb = [e for e in events if e["kind"] == "aot_fallback"]
+    assert [e.get("bucket") for e in fb] == [13]
+    assert fb[0]["reason"] == "missing_bucket"
+
+
+# -- restore: the fails-open ladder ------------------------------------------
+
+
+def test_fingerprint_mismatch_falls_back_to_tracing(
+    params, tmp_path, captured_journal
+):
+    path = str(tmp_path / "model")
+    orbax_io.save_model(path, params, aot=True)
+    man_path = os.path.join(path, "aot", "manifest.json")
+    man = json.load(open(man_path))
+    man["fingerprints"]["cpu"]["jax"] = "0.0.0-not-this-jax"
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    bundle = aot.load_bundle(path)
+    eng = BucketedPredictEngine(
+        params, buckets=BUCKETS, aot=bundle.for_backend("cpu")
+    )
+    eng.warmup()
+    assert not eng._aot_execs
+    assert eng.compile_count() == len(BUCKETS)  # traced everything
+    fb = [
+        e for e in _events(captured_journal) if e["kind"] == "aot_fallback"
+    ]
+    assert len(fb) == 1 and fb[0]["reason"] == "fingerprint_mismatch"
+    assert "jax" in fb[0]["detail"]
+    # ... and the engine still serves (correctness never depended on AOT).
+    assert eng.predict(_query_rows(3)).shape == (3,)
+
+
+def test_wrong_family_bundle_rejected(ckpt, captured_journal):
+    params, bundle, _path = ckpt
+    view = bundle.for_backend("cpu")
+    bad = view.unusable_reason("pipeline")
+    assert bad is not None and bad[0] == "family_mismatch"
+    assert view.unusable_reason("stacking") is None
+    assert view.unusable_reason(None) is None
+    # A backend the bundle never exported reads as missing_backend —
+    # NOT fingerprint skew (an operator alert on fingerprint_mismatch
+    # means "rebuild artifacts"; this one means "expected on this host").
+    bad = bundle.for_backend("tpu").unusable_reason("stacking")
+    assert bad is not None and bad[0] == "missing_backend"
+
+
+def test_corrupt_blob_deserialize_falls_back(
+    params, tmp_path, captured_journal
+):
+    """A blob whose bytes are bad AT PUBLISH (torn, then re-manifested so
+    the checkpoint itself verifies): deserialization fails, the bucket
+    journals a fallback and traces, predictions stay correct."""
+    path = str(tmp_path / "model")
+    orbax_io.save_model(path, params, aot=True)
+    for name in os.listdir(os.path.join(path, "aot")):
+        if name.endswith(".bin"):
+            with open(os.path.join(path, "aot", name), "r+b") as f:
+                first = f.read(1)
+                f.seek(0)
+                f.write(bytes([first[0] ^ 0xFF]))
+    orbax_io._write_integrity(path, version=orbax_io.checkpoint_version(path))
+    assert orbax_io.verify_checkpoint(path, deep=True)  # "intact" ckpt
+    bundle = aot.load_bundle(path)
+    eng = BucketedPredictEngine(
+        params, buckets=BUCKETS, aot=bundle.for_backend("cpu")
+    )
+    eng.warmup()
+    assert not eng._aot_execs
+    assert eng.compile_count() == len(BUCKETS)
+    fb = [
+        e for e in _events(captured_journal) if e["kind"] == "aot_fallback"
+    ]
+    assert len(fb) == len(BUCKETS)
+    assert {e["reason"] for e in fb} == {"deserialize_error"}
+    traced = BucketedPredictEngine(params, buckets=BUCKETS)
+    traced.warmup()
+    rows = _query_rows(5)
+    assert (eng.predict(rows) == traced.predict(rows)).all()
+
+
+def test_foreign_same_shape_bundle_serves_live_params_bits(
+    params, other_params, tmp_path, captured_journal
+):
+    """Params ride the executables as runtime ARGUMENTS, so a blob is
+    weight-agnostic: a bundle exported from a same-shaped checkpoint
+    with different weights restores cleanly and computes with the LIVE
+    engine's params — bit-identical to tracing them. (Structural
+    mismatches — different support-vector counts, different families —
+    fail the load or the probe instead; see the fallback tests.)"""
+    path_a = str(tmp_path / "model_a")
+    path_b = str(tmp_path / "model_b")
+    orbax_io.save_model(path_a, other_params, aot=True)
+    orbax_io.save_model(path_b, params)
+    shutil.copytree(
+        os.path.join(path_a, "aot"), os.path.join(path_b, "aot")
+    )
+    bundle = aot.load_bundle(path_b)
+    eng = BucketedPredictEngine(
+        params, buckets=BUCKETS, aot=bundle.for_backend("cpu")
+    )
+    eng.warmup()
+    assert sorted(eng._aot_execs) == sorted(BUCKETS)
+    assert eng.compile_count() == 0
+    traced = BucketedPredictEngine(params, buckets=BUCKETS)
+    traced.warmup()
+    rows = _query_rows(5)
+    assert (eng.predict(rows) == traced.predict(rows)).all()
+
+
+def test_parity_mismatch_discards_restored_executable(
+    params, captured_journal
+):
+    """The warmup parity probe: a restored executable that cannot
+    reproduce the eager oracle (a miscompile, a garbage blob that
+    nonetheless deserialized and ran) is discarded per bucket, the
+    bucket re-traces, and the engine serves the oracle's bits."""
+
+    class _WrongBitsView:
+        backend = "cpu"
+
+        def unusable_reason(self, family=None):
+            return None
+
+        def load_exec(self, bucket, in_tree, out_tree):
+            def fn(arg, X):
+                n = int(X.shape[0])
+                return np.full((n,), 0.123), np.zeros((n, 3))
+
+            return fn
+
+    eng = BucketedPredictEngine(
+        params, buckets=BUCKETS, aot=_WrongBitsView()
+    )
+    eng.warmup()
+    assert not eng._aot_execs  # every bucket failed the probe
+    assert eng.compile_count() == len(BUCKETS)  # all re-traced
+    assert eng.warm
+    fb = [
+        e for e in _events(captured_journal) if e["kind"] == "aot_fallback"
+    ]
+    assert {e["reason"] for e in fb} == {"parity_mismatch"}
+    assert sorted(e["bucket"] for e in fb) == sorted(BUCKETS)
+    traced = BucketedPredictEngine(params, buckets=BUCKETS)
+    traced.warmup()
+    rows = _query_rows(5)
+    assert (eng.predict(rows) == traced.predict(rows)).all()
+
+
+def test_aot_restore_faultpoint_raise_and_corrupt(ckpt, captured_journal):
+    """The ``persist.aot_restore`` faultpoint (docs/RESILIENCE.md): raise
+    = a failing restore, corrupt = torn blob bytes in flight — both
+    resolve to the journaled tracing fallback, never an unready engine."""
+    params, bundle, _path = ckpt
+    try:
+        faults.arm("persist.aot_restore:raise@n=1")
+        eng = BucketedPredictEngine(
+            params, buckets=BUCKETS, aot=bundle.for_backend("cpu")
+        )
+        eng.warmup()
+        # First bucket's load raised; the second restored.
+        assert sorted(eng._aot_execs) == [8]
+        assert eng.compile_count() == 1
+        faults.arm("persist.aot_restore:corrupt")
+        eng2 = BucketedPredictEngine(
+            params, buckets=BUCKETS, aot=bundle.for_backend("cpu")
+        )
+        eng2.warmup()
+        assert not eng2._aot_execs
+        assert eng2.warm
+    finally:
+        faults.reset()
+    kinds = [e["kind"] for e in _events(captured_journal)]
+    assert "fault_injected" in kinds and "aot_fallback" in kinds
+
+
+# -- serving stack wiring ----------------------------------------------------
+
+
+def test_make_server_serves_identical_bits_with_and_without_aot(ckpt):
+    """make_server(aot_bundle=…) answers /predict with the same bytes a
+    --no-aot stack produces, and its warmup/restore gauges render a
+    strict-valid exposition."""
+    import urllib.request
+
+    from machine_learning_replications_tpu.data.examples import (
+        EXAMPLE_PATIENT,
+    )
+    from machine_learning_replications_tpu.serve import make_server
+
+    params, bundle, _path = ckpt
+
+    def one_probability(**kw):
+        handle = make_server(
+            params, port=0, buckets=BUCKETS, max_wait_ms=1.0,
+            host_path=True, host_buckets=BUCKETS, **kw
+        ).start_background()
+        try:
+            host, port = handle.address
+            req = urllib.request.Request(
+                f"http://{host}:{port}/predict",
+                data=json.dumps(dict(EXAMPLE_PATIENT)).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())["probability"]
+        finally:
+            handle.shutdown()
+
+    p_aot = one_probability(aot_bundle=bundle)
+    p_traced = one_probability(aot_bundle=bundle, use_aot=False)
+    assert p_aot == p_traced
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    try:
+        from validate_metrics import validate
+    finally:
+        sys.path.pop(0)
+
+    from machine_learning_replications_tpu.obs.registry import REGISTRY
+
+    page = REGISTRY.render_prometheus()
+    assert "serve_warmup_seconds" in page
+    assert "serve_aot_restore_seconds" in page
+    assert "serve_aot_fallback_total" in page
+    assert not validate(page)
+
+
+def test_missing_bundle_is_silently_absent(params, tmp_path):
+    path = str(tmp_path / "model")
+    orbax_io.save_model(path, params)  # no aot
+    assert aot.load_bundle(path) is None
+    assert not os.path.exists(os.path.join(path, "aot"))
+
+
+def test_unreadable_manifest_fails_open(params, tmp_path, captured_journal):
+    path = str(tmp_path / "model")
+    orbax_io.save_model(path, params, aot=True)
+    with open(os.path.join(path, "aot", "manifest.json"), "w") as f:
+        f.write("{not json")
+    assert aot.load_bundle(path) is None
+    fb = [
+        e for e in _events(captured_journal) if e["kind"] == "aot_fallback"
+    ]
+    assert len(fb) == 1 and fb[0]["reason"] == "manifest_unreadable"
+
+
+def test_fleet_replica_spec_no_aot_passthrough():
+    from machine_learning_replications_tpu.fleet.lifecycle import (
+        ReplicaSpec,
+    )
+
+    spec = ReplicaSpec(model="/m", register_url="http://r", no_aot=True)
+    assert "--no-aot" in spec.command("r1", 9000)
+    spec = ReplicaSpec(model="/m", register_url="http://r")
+    assert "--no-aot" not in spec.command("r1", 9000)
+
+
+def test_cold_start_rollback_serves_lastgood_version_and_bundle(
+    params, other_params, tmp_path
+):
+    """A replica cold-started on a corrupt primary rolls back to the
+    retained last-known-good — and must take its VERSION and its AOT
+    bundle from the directory that actually restored, never the corrupt
+    target's (the deploy path's info["path"] invariant, now shared by
+    `cli serve`): v1 bits labeled v1, restored from v1's blobs."""
+    import urllib.request
+
+    from machine_learning_replications_tpu.serve.engine import (
+        oracle_proba1,
+    )
+
+    path = str(tmp_path / "model")
+    orbax_io.save_model(path, params, aot=True)        # v1
+    orbax_io.save_model(path, other_params, aot=True)  # v2; v1 → lastgood
+    # Tear the primary's largest payload file: integrity verification
+    # fails the v2 restore and load_model_versioned serves the v1
+    # lastgood (rolled_back).
+    best, size = None, -1
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            fp = os.path.join(root, name)
+            if name != "integrity.json" and os.path.getsize(fp) > size:
+                best, size = fp, os.path.getsize(fp)
+    with open(best, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]) if first else b"\x00")
+
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    jpath = str(tmp_path / "serve.jsonl")
+    # The test process exports under x64 (conftest); the replica must run
+    # the SAME dtype regime or the fingerprint gate — correctly — rejects
+    # the bundle as platform skew (x64 decides every compiled aval).
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "machine_learning_replications_tpu",
+         "serve", "--model", path, "--port", str(port),
+         "--buckets", "1,8", "--journal", jpath],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        deadline = 240
+        import time as _time
+
+        t0 = _time.monotonic()
+        while True:
+            try:
+                with urllib.request.urlopen(base + "/healthz", timeout=2) as r:
+                    health = json.loads(r.read())
+                if health["warm"]:
+                    break
+            except Exception:
+                pass
+            assert _time.monotonic() - t0 < deadline, "never warmed"
+            _time.sleep(0.2)
+        # v1's version, v1's bits, restored (not traced, not fallback'd).
+        assert health["model_version"] == 1, health
+        from machine_learning_replications_tpu.data.examples import (
+            EXAMPLE_PATIENT, patient_row,
+        )
+
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps(dict(EXAMPLE_PATIENT)).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            prob = json.loads(r.read())["probability"]
+        v1_golden = float(oracle_proba1(params, patient_row())[0])
+        v2_golden = float(oracle_proba1(other_params, patient_row())[0])
+        # v1's bits at the engine parity tolerance, and decisively NOT
+        # the corrupt target's model.
+        assert abs(prob - v1_golden) <= 1e-6, (prob, v1_golden)
+        assert abs(prob - v2_golden) > 1e-3, (prob, v2_golden)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    kinds = {e["kind"] for e in _events(jpath)}
+    assert "checkpoint_rollback" in kinds
+    assert "aot_restore" in kinds and "aot_fallback" not in kinds
+
+
+# -- the CI smoke of the whole arc -------------------------------------------
+
+
+def test_coldstart_bench_tiny_smoke(tmp_path):
+    """The satellite's CI gate: the publish → cold-start → AOT-restore →
+    parity → deploy-hold arc end to end over real ``cli serve``
+    subprocesses (--tiny: 1,8 ladder, one repeat — seconds, not a
+    bench). The tool itself exits non-zero if any contract — restored
+    with zero fallbacks, outputs bit-identical — fails."""
+    out = tmp_path / "coldstart_tiny.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "coldstart_bench.py"),
+         "--tiny", "--out", str(out)],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    artifact = json.loads(out.read_text())
+    assert artifact["kind"] == "coldstart_bench"
+    assert all(artifact["contracts"].values()), artifact["contracts"]
+    assert artifact["cold_start"]["aot"]["ready_s"]
+    assert artifact["deploy_hold"]["aot"]["hold_s"]

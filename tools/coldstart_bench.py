@@ -1,0 +1,386 @@
+"""Cold-start bench: replica cold-start-to-ready and rolling-deploy hold,
+traced vs AOT-restored (docs/AOT.md).
+
+The compile wall is a *fixed* cost every replica start pays — it paces
+one-at-a-time deploy holds, the learn loop's promotion window, and the
+autoscaler's reaction time. This bench measures exactly the two arcs
+that cost shows up in, before/after AOT executable restore, on the same
+host with the same config:
+
+  cold start    spawn a real ``cli serve`` subprocess on a published
+                checkpoint and time spawn → ``/readyz`` 200. The traced
+                leg runs ``--no-aot`` (the escape hatch forces the
+                compile path); the AOT leg restores the checkpoint's
+                published executable bundle.
+  deploy hold   a replica with ``--admin-endpoint`` warm-swaps onto a
+                second published version; the hold is the wall time of
+                the ``POST /admin/deploy`` (load + build + warm +
+                parity + swap — what the fleet controller serializes
+                rollouts on).
+
+Both legs assert the parity contract on the way: the traced and AOT
+replicas must serve BIT-IDENTICAL probabilities for the same patient
+(the tentpole's correctness claim), and the AOT leg must restore with
+zero journaled fallbacks.
+
+Usage (CPU sandbox)::
+
+    JAX_PLATFORMS=cpu python tools/coldstart_bench.py \\
+        --repeats 3 --out COLDSTART_r18_cpu.json
+
+    # CI smoke: tiny ladder, one repeat, same assertions
+    JAX_PLATFORMS=cpu python tools/coldstart_bench.py --tiny --out /tmp/cs.json
+
+The artifact embeds the run manifest (``obs.journal.run_manifest``),
+per-leg raw samples with best-of ranges, and the per-bucket
+``serve_warmup_seconds`` / ``serve_aot_restore_seconds`` gauges scraped
+from the live replicas. ``tools/obs_report.py --coldstart`` renders it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from chaos_drill import _free_port, make_sklearn_params  # noqa: E402
+
+POLL_S = 0.05
+
+
+def _serve_cmd(ckpt: str, port: int, buckets: str, no_aot: bool,
+               admin: bool = False, journal: str | None = None) -> list[str]:
+    cmd = [
+        sys.executable, "-m", "machine_learning_replications_tpu",
+        "serve", "--model", ckpt, "--port", str(port),
+        "--buckets", buckets, "--max-wait-ms", "2",
+    ]
+    if no_aot:
+        cmd.append("--no-aot")
+    if admin:
+        cmd.append("--admin-endpoint")
+    if journal:
+        cmd += ["--journal", journal]
+    return cmd
+
+
+def _get_json(base: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_ready(base: str, deadline_s: float) -> float:
+    """Poll /readyz until 200; returns the time it first answered ready
+    (monotonic). Raises on the deadline."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(base + "/readyz", timeout=2.0):
+                return time.monotonic()
+        except (urllib.error.URLError, urllib.error.HTTPError, OSError):
+            time.sleep(POLL_S)
+    raise AssertionError(f"replica at {base} never became ready")
+
+
+def _predict(base: str) -> float:
+    from machine_learning_replications_tpu.data.examples import (
+        EXAMPLE_PATIENT,
+    )
+
+    req = urllib.request.Request(
+        base + "/predict", data=json.dumps(dict(EXAMPLE_PATIENT)).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30.0) as r:
+        return json.loads(r.read())["probability"]
+
+
+_GAUGE_RE = re.compile(
+    r'^(serve_(?:warmup|aot_restore)_seconds)\{([^}]*)\}\s+(\S+)$'
+)
+
+
+def _scrape_warmup_gauges(base: str) -> dict:
+    """The per-bucket warmup/restore gauges off /metrics — the split the
+    deploy controller and autoscaler read (satellite: timings flow
+    through stage_scope + gauges, not stderr prints)."""
+    with urllib.request.urlopen(base + "/metrics", timeout=10.0) as r:
+        page = r.read().decode()
+    out: dict[str, dict[str, float]] = {}
+    for line in page.splitlines():
+        m = _GAUGE_RE.match(line)
+        if m:
+            out.setdefault(m.group(1), {})[m.group(2)] = float(m.group(3))
+    return out
+
+
+def _journal_kinds(path: str) -> tuple[set, set]:
+    """(event kinds, aot_fallback reasons) from one replica journal."""
+    kinds, reasons = set(), set()
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                kinds.add(e.get("kind"))
+                if e.get("kind") == "aot_fallback":
+                    reasons.add(e.get("reason"))
+    return kinds, reasons
+
+
+def _cold_start_leg(ckpt: str, buckets: str, no_aot: bool, repeats: int,
+                    workdir: str, ready_deadline_s: float) -> dict:
+    """N cold starts of one mode; returns raw samples + the last
+    replica's golden probability, warmup gauges, and journal kinds."""
+    samples, golden, gauges = [], None, {}
+    kinds, reasons = set(), set()
+    for i in range(repeats):
+        port = _free_port()
+        base = f"http://127.0.0.1:{port}"
+        jpath = os.path.join(
+            workdir, f"cs_{'traced' if no_aot else 'aot'}_{i}.jsonl"
+        )
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            _serve_cmd(ckpt, port, buckets, no_aot, journal=jpath),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            t_ready = _wait_ready(base, ready_deadline_s)
+            samples.append(round(t_ready - t0, 3))
+            golden = _predict(base)
+            gauges = _scrape_warmup_gauges(base)
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        k, r = _journal_kinds(jpath)
+        kinds |= k
+        reasons |= r
+    return {
+        "ready_s": samples,
+        "best_ready_s": min(samples),
+        "range_s": [min(samples), max(samples)],
+        "golden": golden,
+        "warmup_gauges": gauges,
+        "journal_kinds": sorted(k for k in kinds if k),
+        "fallback_reasons": sorted(r for r in reasons if r),
+    }
+
+
+def _deploy_hold_leg(ckpt_v1: str, ckpt_v2: str, buckets: str,
+                     no_aot: bool, repeats: int, workdir: str,
+                     ready_deadline_s: float) -> dict:
+    """One long-lived replica per mode; N warm-swap deploys onto the v2
+    checkpoint, each hold = the POST /admin/deploy wall time."""
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    jpath = os.path.join(
+        workdir, f"dh_{'traced' if no_aot else 'aot'}.jsonl"
+    )
+    proc = subprocess.Popen(
+        _serve_cmd(ckpt_v1, port, buckets, no_aot, admin=True,
+                   journal=jpath),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    holds, golden = [], None
+    try:
+        _wait_ready(base, ready_deadline_s)
+        for _ in range(repeats):
+            req = urllib.request.Request(
+                base + "/admin/deploy",
+                data=json.dumps({"model": ckpt_v2}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.monotonic()
+            with urllib.request.urlopen(req, timeout=600.0) as r:
+                report = json.loads(r.read())["deploy"]
+            holds.append(round(time.monotonic() - t0, 3))
+            assert report["result"] == "ok", report
+        golden = _predict(base)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return {
+        "hold_s": holds,
+        "best_hold_s": min(holds),
+        "range_s": [min(holds), max(holds)],
+        "golden": golden,
+        "journal_kinds": sorted(
+            k for k in _journal_kinds(jpath)[0] if k
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=None, help="artifact path (JSON)")
+    ap.add_argument(
+        "--repeats", type=int, default=3,
+        help="cold starts (and deploys) per mode; best-of reported with "
+        "the full range",
+    )
+    ap.add_argument(
+        "--buckets", default="1,8,32,64,128,256,512",
+        help="serving ladder under test (the checkpoint's AOT bundle "
+        "always covers the default ladder + host ladder)",
+    )
+    ap.add_argument(
+        "--tiny", action="store_true",
+        help="CI smoke mode: 1,8 ladder, one repeat — exercises the "
+        "whole publish→restore→parity arc in seconds, asserts the same "
+        "contracts, proves nothing about speed",
+    )
+    ap.add_argument(
+        "--ready-deadline", type=float, default=600.0,
+        help="seconds a spawned replica may take to become ready",
+    )
+    ap.add_argument("--keep-workdir", action="store_true")
+    args = ap.parse_args(argv)
+    if args.tiny:
+        args.repeats, args.buckets = 1, "1,8"
+
+    from machine_learning_replications_tpu.obs import journal
+    from machine_learning_replications_tpu.persist import orbax_io
+
+    t_start = time.monotonic()
+    workdir = tempfile.mkdtemp(prefix="coldstart_bench_")
+    try:
+        # One checkpoint with the AOT bundle serves BOTH legs: the
+        # traced leg is `serve --no-aot` over the same bytes — same
+        # model, same config, the only variable is restore vs compile.
+        ckpt_v1 = os.path.join(workdir, "model_v1")
+        ckpt_v2 = os.path.join(workdir, "model_v2")
+        print("publishing checkpoints (with AOT bundles)…",
+              file=sys.stderr)
+        t0 = time.monotonic()
+        orbax_io.save_model(ckpt_v1, make_sklearn_params(seed=7), aot=True)
+        orbax_io.save_model(ckpt_v2, make_sklearn_params(seed=11), aot=True)
+        publish_s = round(time.monotonic() - t0, 3)
+
+        legs = {}
+        for mode, no_aot in (("traced", True), ("aot", False)):
+            print(f"cold start × {args.repeats} [{mode}]…", file=sys.stderr)
+            legs[mode] = _cold_start_leg(
+                ckpt_v1, args.buckets, no_aot, args.repeats, workdir,
+                args.ready_deadline,
+            )
+            print(f"  ready_s={legs[mode]['ready_s']}", file=sys.stderr)
+        holds = {}
+        for mode, no_aot in (("traced", True), ("aot", False)):
+            print(f"deploy hold × {args.repeats} [{mode}]…",
+                  file=sys.stderr)
+            holds[mode] = _deploy_hold_leg(
+                ckpt_v1, ckpt_v2, args.buckets, no_aot, args.repeats,
+                workdir, args.ready_deadline,
+            )
+            print(f"  hold_s={holds[mode]['hold_s']}", file=sys.stderr)
+
+        # The contracts the speedup is worthless without.
+        bit_identical = legs["traced"]["golden"] == legs["aot"]["golden"]
+        deploy_bit_identical = (
+            holds["traced"]["golden"] == holds["aot"]["golden"]
+        )
+        aot_restored = "aot_restore" in legs["aot"]["journal_kinds"]
+        # missing_bucket is excluded from the cleanliness contract: a
+        # caller-supplied --buckets value outside the published ladder
+        # legitimately traces that bucket (correct, fails-open) — the
+        # contract is about BAD artifacts (corrupt/mismatched blobs),
+        # and the full reason list rides the artifact either way.
+        aot_clean = not (
+            set(legs["aot"]["fallback_reasons"]) - {"missing_bucket"}
+        )
+
+        config = {
+            "buckets": args.buckets, "repeats": args.repeats,
+            "tiny": args.tiny,
+        }
+        artifact = {
+            "kind": "coldstart_bench",
+            "manifest": journal.run_manifest(
+                command="coldstart_bench",
+                config_json=json.dumps(config, sort_keys=True),
+            ),
+            "config": config,
+            "publish_with_aot_s": publish_s,
+            "cold_start": {
+                **legs,
+                "speedup_best": round(
+                    legs["traced"]["best_ready_s"]
+                    / legs["aot"]["best_ready_s"], 2,
+                ),
+                "saved_s_best": round(
+                    legs["traced"]["best_ready_s"]
+                    - legs["aot"]["best_ready_s"], 3,
+                ),
+            },
+            "deploy_hold": {
+                **holds,
+                "speedup_best": round(
+                    holds["traced"]["best_hold_s"]
+                    / holds["aot"]["best_hold_s"], 2,
+                ),
+                "saved_s_best": round(
+                    holds["traced"]["best_hold_s"]
+                    - holds["aot"]["best_hold_s"], 3,
+                ),
+            },
+            "contracts": {
+                "bit_identical_cold_start": bit_identical,
+                "bit_identical_post_deploy": deploy_bit_identical,
+                "aot_restored": aot_restored,
+                "aot_zero_fallbacks": aot_clean,
+            },
+            "duration_s": round(time.monotonic() - t_start, 3),
+        }
+        line = json.dumps(artifact, indent=1)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+            print(f"artifact written to {args.out}", file=sys.stderr)
+        ok = (
+            bit_identical and deploy_bit_identical
+            and aot_restored and aot_clean
+        )
+        if not ok:
+            print("COLDSTART CONTRACTS VIOLATED", file=sys.stderr)
+            return 1
+        print(
+            "cold start best-of: traced "
+            f"{legs['traced']['best_ready_s']}s vs aot "
+            f"{legs['aot']['best_ready_s']}s "
+            f"({artifact['cold_start']['speedup_best']}×); deploy hold "
+            f"{holds['traced']['best_hold_s']}s vs "
+            f"{holds['aot']['best_hold_s']}s "
+            f"({artifact['deploy_hold']['speedup_best']}×); outputs "
+            "bit-identical",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        if args.keep_workdir:
+            print(f"workdir kept at {workdir}", file=sys.stderr)
+        else:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

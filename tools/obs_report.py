@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import urllib.error
 import urllib.request
@@ -984,6 +985,64 @@ def _section_static_analysis(rep: Report, gc: dict | None):
         )
 
 
+def _section_coldstart(rep: Report, cs: dict | None):
+    """The "Cold start" section (docs/AOT.md): a coldstart_bench
+    artifact's replica cold-start-to-ready and rolling-deploy hold,
+    traced vs AOT-restored, with the contract verdicts (bit-identical
+    outputs, zero fallbacks) the speedup is worthless without."""
+    if cs is None:
+        return
+    rep.h("Cold start")
+    cfg = cs.get("config") or {}
+    rep.kv("ladder", cfg.get("buckets"))
+    rep.kv("repeats per mode", cfg.get("repeats"))
+    rep.kv("publish with AOT bundle", f"{cs.get('publish_with_aot_s')} s")
+    rows = []
+    for arc, key, unit in (
+        ("cold start → ready", "cold_start", "best_ready_s"),
+        ("deploy hold", "deploy_hold", "best_hold_s"),
+    ):
+        block = cs.get(key) or {}
+        traced = block.get("traced") or {}
+        aot = block.get("aot") or {}
+        rows.append((
+            arc,
+            f"{traced.get(unit)} s "
+            f"(range {'–'.join(map(str, traced.get('range_s', [])))})",
+            f"{aot.get(unit)} s "
+            f"(range {'–'.join(map(str, aot.get('range_s', [])))})",
+            f"{block.get('speedup_best')}×",
+            f"{block.get('saved_s_best')} s",
+        ))
+    rep.table(
+        ("arc", "traced (best-of)", "AOT (best-of)", "speedup", "saved"),
+        rows,
+    )
+    contracts = cs.get("contracts") or {}
+    rep.kv(
+        "contracts",
+        ", ".join(
+            f"{k}={'yes' if v else 'NO'}" for k, v in contracts.items()
+        ) or "none recorded",
+    )
+    gauges = ((cs.get("cold_start") or {}).get("aot") or {}).get(
+        "warmup_gauges"
+    ) or {}
+    restore = gauges.get("serve_aot_restore_seconds") or {}
+    if restore:
+        def _bucket_key(labels: str) -> tuple:
+            # Numeric bucket order, not lexicographic (128 after 64,
+            # not after 1); path label breaks ties.
+            m = re.search(r'bucket="(\d+)"', labels)
+            return (int(m.group(1)) if m else 1 << 30, labels)
+
+        rep.table(
+            ("bucket", "restore_s"),
+            [(labels, f"{restore[labels]:.4f}")
+             for labels in sorted(restore, key=_bucket_key)],
+        )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--url", help="live server base URL")
@@ -1025,12 +1084,19 @@ def main(argv=None) -> int:
         "'Static analysis' section (rules run, findings, baseline debt "
         "+ oldest expiry)",
     )
+    ap.add_argument(
+        "--coldstart",
+        help="a tools/coldstart_bench.py COLDSTART_*.json artifact: "
+        "renders the 'Cold start' section (replica ready time + deploy "
+        "hold, traced vs AOT, with the parity contract verdicts)",
+    )
     ap.add_argument("--tail", type=int, default=10,
                     help="slowest sampled traces to show")
     ap.add_argument("--out", help="report path (default: stdout)")
     args = ap.parse_args(argv)
     if not (args.url or args.journal or args.metrics or args.requests
-            or args.quality or args.score_bench or args.graftcheck):
+            or args.quality or args.score_bench or args.graftcheck
+            or args.coldstart):
         ap.error("nothing to report on: give --url and/or input files")
 
     health = metrics = requests = quality = fleet_replicas = None
@@ -1075,6 +1141,9 @@ def main(argv=None) -> int:
     _section_run(rep, manifest, health)
     _section_static_analysis(
         rep, _load_json(args.graftcheck) if args.graftcheck else None
+    )
+    _section_coldstart(
+        rep, _load_json(args.coldstart) if args.coldstart else None
     )
     if args.learn:
         # The continual-learning arc leads; the fleet/serving sections
