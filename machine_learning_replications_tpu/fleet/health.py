@@ -21,41 +21,46 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
 
 def probe_replica(url: str, timeout_s: float = 2.0) -> dict:
-    """One ``/readyz`` probe: ``{"ok", "ready", "version",
-    "queue_depth"}``. ``ok`` is HTTP-level success (an explicit 503 is
-    ok=True, ready=False — the replica answered, and said no); transport
-    failures are ok=False. ``queue_depth`` (None when the replica
-    predates the field) feeds the registry's least-loaded score — the
-    probe the rotation already pays for doubles as the cross-router
-    load signal. Never raises."""
+    """One ``/readyz`` probe: ``{"ok", "ready", "version", "queue_depth",
+    "clock_perf", "t_send", "t_recv"}``. ``ok`` is HTTP-level success (an
+    explicit 503 is ok=True, ready=False — the replica answered, and said
+    no); transport failures are ok=False. ``queue_depth`` (None when the
+    replica predates the field) feeds the registry's least-loaded score —
+    the probe the rotation already pays for doubles as the cross-router
+    load signal. ``clock_perf`` (the replica's monotonic clock echoed in
+    the body, None on older replicas) plus the local send/receive stamps
+    around the call feed the router's per-replica clock-offset estimator
+    (``obs.fleettrace.ClockSync``) from the same GET. Never raises."""
+    t_send = time.perf_counter()
     try:
         with urllib.request.urlopen(
             url.rstrip("/") + "/readyz", timeout=timeout_s
         ) as resp:
             body = json.loads(resp.read())
-        return {
-            "ok": True, "ready": bool(body.get("ready")),
-            "version": body.get("version"),
-            "queue_depth": body.get("queue_depth"),
-        }
+        ok = True
     except urllib.error.HTTPError as exc:
         try:
             body = json.loads(exc.read() or b"{}")
         except (ValueError, OSError):
             body = {}
-        return {
-            "ok": True, "ready": bool(body.get("ready")),
-            "version": body.get("version"),
-            "queue_depth": body.get("queue_depth"),
-        }
+        ok = True
     except Exception:
-        return {"ok": False, "ready": False, "version": None,
-                "queue_depth": None}
+        body, ok = {}, False
+    t_recv = time.perf_counter()
+    clock = body.get("clock_perf")
+    return {
+        "ok": ok, "ready": bool(body.get("ready")),
+        "version": body.get("version"),
+        "queue_depth": body.get("queue_depth"),
+        "clock_perf": clock if isinstance(clock, (int, float)) else None,
+        "t_send": t_send, "t_recv": t_recv,
+    }
 
 
 class HealthProber:
@@ -66,10 +71,14 @@ class HealthProber:
         registry,
         interval_s: float = 0.5,
         timeout_s: float = 2.0,
+        clock_sync=None,
     ) -> None:
         self.registry = registry
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
+        # Optional obs.fleettrace.ClockSync: probes double as NTP-style
+        # offset samples for the fleet trace join.
+        self.clock_sync = clock_sync
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="fleet-prober", daemon=True
@@ -86,10 +95,20 @@ class HealthProber:
             if self._stop.is_set():
                 return
             verdict = probe_replica(url, timeout_s=self.timeout_s)
+            offset_ms = None
+            if (
+                self.clock_sync is not None and verdict["ok"]
+                and verdict.get("clock_perf") is not None
+            ):
+                offset_ms = 1000.0 * self.clock_sync.observe(
+                    replica_id, verdict["t_send"], verdict["t_recv"],
+                    verdict["clock_perf"],
+                )
             self.registry.observe_probe(
                 replica_id, ok=verdict["ok"], ready=verdict["ready"],
                 version=verdict["version"],
                 queue_depth=verdict.get("queue_depth"),
+                clock_offset_ms=offset_ms,
             )
 
     def _loop(self) -> None:

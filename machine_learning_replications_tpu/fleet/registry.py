@@ -105,7 +105,7 @@ class Replica:
         "probe_fails", "probe_oks", "request_fails",
         "registered_at", "last_probe_at", "last_change_at",
         "outstanding", "ewma_latency_ms", "last_queue_depth",
-        "last_pick_seq",
+        "last_pick_seq", "clock_offset_ms",
     )
 
     def __init__(self, replica_id: str, url: str) -> None:
@@ -128,6 +128,10 @@ class Replica:
         self.ewma_latency_ms: float | None = None
         self.last_queue_depth: int | None = None
         self.last_pick_seq = 0  # LRU tie-break for the cold fleet
+        # Smoothed replica-minus-router monotonic-clock offset (from the
+        # prober's ClockSync feed); None until the first clock-carrying
+        # probe. Surfaced on /fleet/replicas for trace-join debugging.
+        self.clock_offset_ms: float | None = None
 
     #: Latency prior (ms) for a replica with no sample yet: low enough
     #: that exploration beats any realistically-warm replica's score, so
@@ -166,6 +170,10 @@ class Replica:
             "request_fails": self.request_fails,
             "registered_at": self.registered_at,
             "last_probe_at": self.last_probe_at,
+            "clock_offset_ms": (
+                None if self.clock_offset_ms is None
+                else round(self.clock_offset_ms, 3)
+            ),
             # The load view the balancer picks on (docs/FLEET.md "Router
             # data plane") — operators and the autoscaler read the same
             # numbers that drive rotation.
@@ -422,6 +430,7 @@ class ReplicaRegistry:
         self, replica_id: str, ok: bool, ready: bool,
         version: int | None = None,
         queue_depth: int | None = None,
+        clock_offset_ms: float | None = None,
     ) -> None:
         """Prober feedback for one replica: ``ok`` means the probe got an
         HTTP answer at all, ``ready`` the replica's own readiness verdict
@@ -429,7 +438,9 @@ class ReplicaRegistry:
         still counts against rotation, but as ``not_ready`` rather than
         a transport failure). ``queue_depth`` is the replica's own
         admission-queue depth off the same probe — the cross-router load
-        signal ``pick`` folds into its score."""
+        signal ``pick`` folds into its score. ``clock_offset_ms`` is the
+        smoothed clock offset the prober's ClockSync derived from the
+        same probe (display-only here; the join reads ClockSync)."""
         FLEET_PROBES.inc(
             result="ok" if ok and ready else
             "not_ready" if ok else "error"
@@ -452,6 +463,8 @@ class ReplicaRegistry:
                     rep.last_queue_depth = max(0, int(queue_depth))
                 except (TypeError, ValueError):
                     pass
+            if ok and clock_offset_ms is not None:
+                rep.clock_offset_ms = float(clock_offset_ms)
             if ok and ready:
                 rep.probe_fails = 0
                 rep.probe_oks += 1

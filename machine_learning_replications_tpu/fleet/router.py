@@ -58,9 +58,16 @@ deregister — ``cli serve --register`` posts here), ``/fleet/deploy``
 (POST starts a rolling deploy through ``fleet.deploy``; GET status),
 ``/healthz`` / ``/readyz`` (a router with zero in-rotation replicas is
 alive but not ready), ``/metrics`` (``fleet_*`` families through the
-process registry, strict-exposition clean), and ``/debug/requests``
-(the router's own flight-recorded traces: route → upstream → respond
-phase attribution per sampled request).
+process registry, strict-exposition clean), ``/fleet/metrics`` (the
+aggregated fleet exposition: in-rotation replicas scraped and merged
+per ``obs.fleetmetrics``, stale replicas marked, the router's own
+families appended), ``/fleet/trace`` (the cross-process joined
+timeline: the router's tail-sampled traces with each serving replica's
+phases fetched by request id and offset-corrected into the upstream
+span, per ``obs.fleettrace``), and ``/debug/requests`` (the router's
+own flight-recorded traces: route → upstream → respond phase
+attribution per sampled request; ``?id=`` exact lookup over the
+all-completions index).
 
 No jax imports anywhere on this path (graftcheck rule
 ``import-purity`` proves it transitively in CI) — the router starts in
@@ -78,7 +85,12 @@ import threading
 import time
 import urllib.parse
 
-from machine_learning_replications_tpu.obs import journal, reqtrace
+from machine_learning_replications_tpu.obs import (
+    fleetmetrics,
+    fleettrace,
+    journal,
+    reqtrace,
+)
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.fleet.health import HealthProber
 from machine_learning_replications_tpu.fleet.registry import ReplicaRegistry
@@ -666,6 +678,12 @@ class _RouterApp:
         )
         _REQ_OUTCOME[outcome].inc()
         _LATENCY.observe(trace.total_s)
+        if outcome != "bad_request":
+            # Fleet-level SLO: burn accounted where clients experience
+            # it. A malformed request is the client's fault — it spends
+            # no server error budget (same exclusion the replica-side
+            # tracker applies to non-admitted requests).
+            self.handle.fleet_slo.observe(trace.total_s, outcome == "ok")
         self.recorder.record(trace)
         if self.handle.capture_feed is not None and outcome == "ok":
             # Continual-learning tap (learn.capture): every SERVED row
@@ -724,6 +742,16 @@ class _RouterApp:
         elif path == "/fleet/deploy":
             rsp.send_json(200, {"deploy": self.handle.deploy_status})
         elif path == "/debug/requests":
+            rid = req.query_param("id", "")
+            if rid:
+                snap = self.recorder.lookup(rid)
+                if snap is None:
+                    rsp.send_json(404, {
+                        "error": f"request id not indexed: {rid}",
+                    })
+                else:
+                    rsp.send_json(200, {"request": snap})
+                return
             try:
                 n = int(req.query_param("n", "64"))
             except ValueError:
@@ -733,6 +761,27 @@ class _RouterApp:
                 "stats": self.recorder.stats(),
                 "requests": self.recorder.snapshot(n),
             })
+        elif path == "/fleet/metrics":
+            # The scrape blocks up to timeout_s per replica — on its own
+            # short-lived thread (the /debug/profile pattern), never the
+            # event loop that carries the data plane.
+            threading.Thread(
+                target=self._fleet_metrics,
+                args=(req.query_param("format", "prometheus"), rsp),
+                name="fleet-metrics-scrape", daemon=True,
+            ).start()
+        elif path == "/fleet/trace":
+            try:
+                n = int(req.query_param("n", "64"))
+            except ValueError:
+                rsp.send_json(400, {"error": "n must be an integer"})
+                return
+            # Same off-loop discipline: the join fetches one replica
+            # trace per sampled request over blocking HTTP.
+            threading.Thread(
+                target=self._fleet_trace, args=(n, rsp),
+                name="fleet-trace-join", daemon=True,
+            ).start()
         elif path == "/metrics":
             if req.query_param("format", "prometheus") == "json":
                 rsp.send_json(200, {
@@ -746,6 +795,39 @@ class _RouterApp:
                 )
         else:
             rsp.send_json(404, {"error": f"no such path: {path}"})
+
+    def _fleet_metrics(self, fmt: str, rsp) -> None:
+        """Thread target for GET /fleet/metrics (off-loop; the Responder
+        is thread-safe and exactly-once)."""
+        try:
+            text, summary = self.handle.scraper.render_fleet_page()
+        except Exception as exc:
+            rsp.send_json(500, {"error": f"fleet scrape failed: {exc}"})
+            return
+        if fmt == "json":
+            rsp.send_json(200, {"summary": summary, "page": text})
+        else:
+            rsp.send(200, text.encode(), "text/plain; version=0.0.4")
+
+    def _fleet_trace(self, n: int, rsp) -> None:
+        """Thread target for GET /fleet/trace: join the router's last
+        ``n`` tail-sampled traces with their replica-side phases into
+        one Perfetto-loadable export (the response body IS the trace
+        JSON — save it to a file and load it)."""
+        try:
+            samples = self.recorder.snapshot(n)
+            urls = {
+                r["id"]: r["url"] for r in self.registry.snapshot()
+            }
+            export = fleettrace.join_fleet_trace(
+                samples, urls, self.handle.clock_sync,
+            )
+        except Exception as exc:
+            rsp.send_json(500, {
+                "error": f"fleet trace join failed: {exc}",
+            })
+            return
+        rsp.send_json(200, export)
 
     @loop_only
     def _post_replicas(self, req, rsp) -> None:
@@ -853,12 +935,19 @@ class RouterHandle:
     upstream pool + event-loop HTTP listener."""
 
     def __init__(self, registry, prober, recorder,
-                 httpd=None, capture=None) -> None:
+                 httpd=None, capture=None, clock_sync=None,
+                 scraper=None, fleet_slo=None) -> None:
         self.registry = registry
         self.prober = prober
         self.recorder = recorder
         self.httpd = httpd
         self.upstream: UpstreamPool | None = None
+        # The fleet telemetry plane (obs.fleettrace / obs.fleetmetrics):
+        # per-replica clock-offset estimator, /fleet/metrics scraper,
+        # and the fleet-level SLO tracker fed from finish().
+        self.clock_sync = clock_sync or fleettrace.ClockSync()
+        self.scraper = scraper or fleetmetrics.FleetScraper(registry)
+        self.fleet_slo = fleet_slo or fleetmetrics.fleet_slo_tracker()
         self.capture = capture  # learn.capture.CohortCapture or None
         self.capture_feed: _CaptureFeed | None = (
             _CaptureFeed(capture) if capture is not None else None
@@ -942,8 +1031,10 @@ def make_router(
     )
     for rid, url in replicas or []:
         registry.register(rid, url)
+    clock_sync = fleettrace.ClockSync()
     prober = HealthProber(
-        registry, interval_s=probe_interval_s, timeout_s=probe_timeout_s
+        registry, interval_s=probe_interval_s, timeout_s=probe_timeout_s,
+        clock_sync=clock_sync,
     )
     recorder = reqtrace.FlightRecorder(
         capacity=trace_capacity, tail_quantile=tail_quantile
@@ -959,7 +1050,13 @@ def make_router(
             rows_per_shard=capture_rows_per_shard,
             max_shards=capture_max_shards,
         )
-    handle = RouterHandle(registry, prober, recorder, capture=capture)
+    handle = RouterHandle(
+        registry, prober, recorder, capture=capture,
+        clock_sync=clock_sync,
+        scraper=fleetmetrics.FleetScraper(
+            registry, timeout_s=probe_timeout_s,
+        ),
+    )
     app = _RouterApp(
         handle, request_timeout_s,
         hedge_s=hedge_ms / 1000.0, max_attempts=max_attempts, quiet=quiet,

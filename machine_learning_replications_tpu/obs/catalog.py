@@ -85,6 +85,17 @@ METRICS: dict[str, tuple[str, tuple[str, ...]]] = {
     "fleet_deploys_total": ("counter", ("result",)),
     "fleet_upstream_connections_total": ("counter", ("event",)),
     "fleet_capture_dropped_total": ("counter", ()),
+    "fleet_clock_offset_ms": ("gauge", ("replica",)),
+    "fleet_trace_joins_total": ("counter", ("result",)),
+    "fleet_scrape_total": ("counter", ("result",)),
+    "fleet_scrape_stale": ("gauge", ("replica",)),
+    "fleet_scrape_merge_rejected_total": ("counter", ("reason",)),
+    "fleet_slo_requests_total": ("counter", ("slo",)),
+    "fleet_slo_bad_total": ("counter", ("slo",)),
+    "fleet_slo_good_ratio": ("gauge", ("slo",)),
+    "fleet_slo_burn_rate": ("gauge", ("slo",)),
+    "fleet_slo_error_budget_remaining_ratio": ("gauge", ("slo",)),
+    "fleet_slo_target_ratio": ("gauge", ("slo",)),
     "lifecycle_transitions_total": ("counter", ("event",)),
     "lifecycle_replicas": ("gauge", ("state",)),
     "autoscale_decisions_total": ("counter", ("decision",)),
@@ -178,6 +189,8 @@ EVENTS: dict[str, tuple[str, ...]] = {
     "fleet_deploy_done": (
         "model", "target_version", "result", "error", "seconds",
     ),
+    "fleet_trace_export": ("requests", "joined", "containment_ratio"),
+    "fleet_scrape_transition": ("replica", "stale"),
     "replica_registered": ("replica", "router", "url"),
     "lifecycle_spawn": ("replica", "pid", "port", "attempt", "respawn"),
     "lifecycle_spawn_failed": (

@@ -37,6 +37,7 @@ Import-safe without jax (stdlib + numpy), same as ``journal``/``registry``.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import os
 import threading
@@ -216,6 +217,11 @@ class RequestTrace:
             "request_id": self.request_id,
             "status": self.status,
             "ts": self.wall_start,
+            # Raw perf_counter admission stamp: the anchor the fleet
+            # trace join (obs.fleettrace) maps through the per-replica
+            # clock offset — offsets alone cannot place this trace on
+            # another process's timeline.
+            "t_start_perf": round(self.t_start, 6),
             "total_seconds": round(self.total_s, 6),
             "phases": {
                 name: {
@@ -249,6 +255,15 @@ class FlightRecorder:
 
     The ring holds at most ``capacity`` snapshots (dicts, not live trace
     objects); memory stays bounded for the life of the process.
+
+    Separately from the sampled ring, EVERY completed trace is indexed by
+    request id in a bounded FIFO (``index_capacity`` most recent) for
+    ``lookup`` — the ``/debug/requests?id=`` exact fetch the fleet trace
+    join rides on. Tail sampling alone cannot serve that join: the router
+    and a replica sample independently, so a router-sampled request would
+    usually be dropped replica-side. The index stores the finished (hence
+    immutable) trace *objects* and snapshots only on lookup, so the hot
+    path pays one dict insert, not a snapshot build per request.
     """
 
     def __init__(
@@ -257,6 +272,7 @@ class FlightRecorder:
         tail_quantile: float = 0.99,
         window: int = 2048,
         min_window: int = 32,
+        index_capacity: int = 4096,
     ) -> None:
         if not 0.0 < tail_quantile < 1.0:
             raise ValueError(
@@ -266,10 +282,17 @@ class FlightRecorder:
             raise ValueError(
                 f"capacity and window must be >= 1, got {capacity}/{window}"
             )
+        if index_capacity < 1:
+            raise ValueError(
+                f"index_capacity must be >= 1, got {index_capacity}"
+            )
         self.capacity = int(capacity)
         self.tail_quantile = float(tail_quantile)
         self.min_window = int(min_window)
+        self.index_capacity = int(index_capacity)
         self._lock = threading.Lock()
+        self._by_id: collections.OrderedDict[str, RequestTrace] = \
+            collections.OrderedDict()
         self._samples: list[dict] = []
         self._next = 0  # ring write index
         self._lat = np.empty(int(window), np.float64)
@@ -311,6 +334,13 @@ class FlightRecorder:
         Chrome-trace export."""
         total = trace.total_s
         with self._lock:
+            # Exact-lookup index first: EVERY completed trace, sampled or
+            # not (a re-used request id overwrites — latest completion
+            # wins, and re-inserting refreshes its FIFO position).
+            self._by_id[trace.request_id] = trace
+            self._by_id.move_to_end(trace.request_id)
+            while len(self._by_id) > self.index_capacity:
+                self._by_id.popitem(last=False)
             if trace.status == "ok":
                 threshold = self._tail_threshold_locked()
                 self._lat[self._lat_n % self._lat.shape[0]] = total
@@ -356,16 +386,28 @@ class FlightRecorder:
         ordered.reverse()
         return ordered if n is None else ordered[: max(int(n), 0)]
 
+    def lookup(self, request_id: str) -> dict | None:
+        """Exact fetch by request id over the completed-trace index (the
+        ``/debug/requests?id=`` primitive). None when the id never
+        completed here or has been evicted (FIFO, ``index_capacity``
+        most recent)."""
+        with self._lock:
+            trace = self._by_id.get(request_id)
+        return None if trace is None else trace.snapshot()
+
     def stats(self) -> dict:
         with self._lock:
             n_lat = min(self._lat_n, self._lat.shape[0])
             threshold = self._tail_threshold_locked()
             dropped = self._dropped_n
+            indexed = len(self._by_id)
         return {
             "capacity": self.capacity,
             "stored": min(self._next, self.capacity),
             "kept_total": self._next,
             "dropped_total": dropped,
+            "indexed": indexed,
+            "index_capacity": self.index_capacity,
             "tail_quantile": self.tail_quantile,
             "tail_threshold_seconds": (
                 None if threshold is None else round(threshold, 6)

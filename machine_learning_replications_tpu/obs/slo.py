@@ -131,6 +131,7 @@ class SLOTracker:
         slos: Sequence[SLO],
         registry: MetricsRegistry | None = None,
         window: int = 2048,
+        families: dict | None = None,
     ) -> None:
         names = [s.name for s in slos]
         if len(set(names)) != len(names):
@@ -138,35 +139,47 @@ class SLOTracker:
         reg = registry or REGISTRY
         self._lock = threading.Lock()
         self._state = [_PerSLO(s, int(window)) for s in slos]
-        self._requests = reg.counter(
-            "slo_requests_total", "Requests evaluated against the SLO.",
-            labels=("slo",),
-        )
-        self._bad = reg.counter(
-            "slo_bad_total", "Requests that violated the SLO.",
-            labels=("slo",),
-        )
-        self._good_ratio = reg.gauge(
-            "slo_good_ratio",
-            "Good-event ratio over the recent request window.",
-            labels=("slo",),
-        )
-        self._burn = reg.gauge(
-            "slo_burn_rate",
-            "Error-budget burn rate over the recent window (bad ratio / "
-            "budget; 1.0 = burning exactly at the sustainable rate).",
-            labels=("slo",),
-        )
-        self._remaining = reg.gauge(
-            "slo_error_budget_remaining_ratio",
-            "Lifetime error budget remaining (1 = untouched, 0 = spent, "
-            "negative = blown).",
-            labels=("slo",),
-        )
-        self._target = reg.gauge(
-            "slo_target_ratio", "The declared SLO target (constant).",
-            labels=("slo",),
-        )
+        if families is not None:
+            # A caller (the fleet-level tracker in obs.fleetmetrics)
+            # supplies pre-registered family objects under its own
+            # names; the catalog rule wants family names as literals at
+            # their registration site, so the names cannot be built here.
+            self._requests = families["requests"]
+            self._bad = families["bad"]
+            self._good_ratio = families["good_ratio"]
+            self._burn = families["burn"]
+            self._remaining = families["remaining"]
+            self._target = families["target"]
+        else:
+            self._requests = reg.counter(
+                "slo_requests_total", "Requests evaluated against the SLO.",
+                labels=("slo",),
+            )
+            self._bad = reg.counter(
+                "slo_bad_total", "Requests that violated the SLO.",
+                labels=("slo",),
+            )
+            self._good_ratio = reg.gauge(
+                "slo_good_ratio",
+                "Good-event ratio over the recent request window.",
+                labels=("slo",),
+            )
+            self._burn = reg.gauge(
+                "slo_burn_rate",
+                "Error-budget burn rate over the recent window (bad ratio "
+                "/ budget; 1.0 = burning exactly at the sustainable rate).",
+                labels=("slo",),
+            )
+            self._remaining = reg.gauge(
+                "slo_error_budget_remaining_ratio",
+                "Lifetime error budget remaining (1 = untouched, 0 = "
+                "spent, negative = blown).",
+                labels=("slo",),
+            )
+            self._target = reg.gauge(
+                "slo_target_ratio", "The declared SLO target (constant).",
+                labels=("slo",),
+            )
         for st in self._state:
             s = st.slo
             # Materialize every series at declaration: a scrape taken

@@ -249,15 +249,23 @@ class Tracer:
     def write(self, path: str | os.PathLike) -> str:
         """Write the trace JSON to ``path`` (parent dirs created); returns
         the absolute path."""
-        path = os.path.abspath(os.fspath(path))
-        parent = os.path.dirname(path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.export(), f)
-        os.replace(tmp, path)
-        return path
+        return write_trace(path, self.export())
+
+
+def write_trace(path: str | os.PathLike, trace: dict) -> str:
+    """Atomically write a Chrome-trace JSON object (parent dirs created);
+    returns the absolute path. Shared by ``Tracer.write`` and the fleet
+    trace join (``obs.fleettrace``), whose export is assembled from
+    cross-process snapshots rather than a live tracer."""
+    path = os.path.abspath(os.fspath(path))
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, path)
+    return path
 
 
 # -- process-global active tracer ------------------------------------------

@@ -74,7 +74,10 @@ Endpoints:
                   the flight recorder's tail-sampled request traces
                   (every failure + the p99-slowest completions), newest
                   first, with recorder stats and per-SLO state. ``?n=K``
-                  caps the trace count (default 64).
+                  caps the trace count (default 64). ``?id=<request-id>``
+                  is an exact lookup over the recorder's all-completions
+                  index (JSON 404 when the id aged out) — the fetch
+                  primitive behind the router's fleet trace join.
   GET  /debug/profile?seconds=N
                   on-demand ``jax.profiler`` capture of N wall seconds
                   (default 1) while traffic keeps flowing; replies with
@@ -931,6 +934,11 @@ class _App:
                     # least-loaded score and the autoscaler both read
                     # replica load without an extra request.
                     "queue_depth": self.batcher.queue_depth,
+                    # This process's monotonic clock, echoed so the
+                    # router's ClockSync can estimate the per-replica
+                    # offset (NTP-style midpoint) and place replica-side
+                    # trace phases on the router's timeline.
+                    "clock_perf": time.perf_counter(),
                 },
             )
         elif path == "/admin/deploy":
@@ -971,6 +979,20 @@ class _App:
             else:
                 rsp.send_json(200, handle.quality.snapshot(detail=True))
         elif path == "/debug/requests":
+            rid = req.query_param("id", "")
+            if rid:
+                # Exact lookup by request id (the fleet trace join's
+                # fetch primitive): every completed request is indexed,
+                # not just the tail-sampled ring, since the router and
+                # replica sample independently.
+                snap = self.recorder.lookup(rid)
+                if snap is None:
+                    rsp.send_json(404, {
+                        "error": f"request id not indexed: {rid}",
+                    })
+                else:
+                    rsp.send_json(200, {"request": snap})
+                return
             try:
                 n = int(req.query_param("n", "64"))
             except ValueError:

@@ -626,8 +626,13 @@ def test_probe_replica_verdicts():
     httpd, url = _start_stub(stub)
     try:
         v = probe_replica(url)
-        assert v == {"ok": True, "ready": True, "version": 7,
-                     "queue_depth": None}
+        # NTP-style clock sampling (obs.fleettrace): the prober stamps
+        # t_send/t_recv around the probe; clock_perf is None unless the
+        # replica echoes its perf_counter on /readyz (the stub doesn't).
+        assert v["t_send"] <= v["t_recv"]
+        assert v["clock_perf"] is None
+        assert {k: v[k] for k in ("ok", "ready", "version", "queue_depth")} \
+            == {"ok": True, "ready": True, "version": 7, "queue_depth": None}
         stub.ready = False
         v = probe_replica(url)
         assert v["ok"] and not v["ready"]

@@ -1,0 +1,550 @@
+"""Fleet telemetry plane (obs.fleettrace / obs.fleetmetrics + router
+wiring): clock-offset estimation, cross-process trace join, exposition
+merge math, scrape staleness, and the router's /fleet/metrics +
+/fleet/trace + /debug/requests?id= endpoints.
+
+Merge math and the join run against synthetic pages/snapshots (goldens —
+the semantics are arithmetic, not I/O); the endpoint tests run the real
+router over stub replicas on the real transport, the same pattern as
+test_fleet.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from machine_learning_replications_tpu.fleet import make_router
+from machine_learning_replications_tpu.obs import fleetmetrics, fleettrace
+from machine_learning_replications_tpu.obs.reqtrace import (
+    FlightRecorder,
+    RequestTrace,
+)
+from machine_learning_replications_tpu.serve.transport import (
+    EventLoopHttpServer,
+)
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from validate_metrics import validate  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimator
+# ---------------------------------------------------------------------------
+
+
+def test_clock_sync_recovers_synthetic_skew():
+    """A replica whose perf clock runs 5 s ahead: the midpoint estimate
+    recovers the skew to within RTT/2 on the first probe."""
+    cs = fleettrace.ClockSync()
+    # Probe took 10 ms; replica stamped its clock exactly at the
+    # midpoint, so the estimate is exact.
+    off = cs.observe("r1", t_send=100.0, t_recv=100.010,
+                     replica_clock=105.005)
+    assert off == pytest.approx(5.0, abs=1e-9)
+    assert cs.offset_s("r1") == pytest.approx(5.0, abs=1e-9)
+
+    # EWMA smoothing: a second, slightly-off sample moves the estimate
+    # by alpha * innovation, not to the raw value.
+    cs.observe("r1", t_send=101.0, t_recv=101.010,
+               replica_clock=106.015)  # raw = 5.010
+    expected = 5.0 + fleettrace.ClockSync.EWMA_ALPHA * 0.010
+    assert cs.offset_s("r1") == pytest.approx(expected, abs=1e-9)
+
+    snap = cs.snapshot()
+    assert snap["r1"]["samples"] == 2
+    assert snap["r1"]["rtt_ms"] == pytest.approx(10.0, abs=1e-6)
+
+    cs.forget("r1")
+    assert cs.offset_s("r1") is None
+
+
+def test_clock_sync_negative_skew():
+    cs = fleettrace.ClockSync()
+    cs.observe("r2", t_send=50.0, t_recv=50.002, replica_clock=20.001)
+    assert cs.offset_s("r2") == pytest.approx(-30.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder exact lookup (the join's fetch primitive)
+# ---------------------------------------------------------------------------
+
+
+def _finished_trace(rid, status="ok"):
+    tr = RequestTrace(rid)
+    t0 = tr.t_start
+    tr.add_phase("parse", t0, t0 + 0.001)
+    tr.finish(status)
+    return tr
+
+
+def test_flight_recorder_lookup_indexes_all_completions():
+    rec = FlightRecorder(capacity=4, index_capacity=8)
+    for i in range(6):
+        rec.record(_finished_trace(f"req-{i}"))
+    # Every completion is indexed, not just the tail-sampled ring.
+    snap = rec.lookup("req-0")
+    assert snap is not None and snap["request_id"] == "req-0"
+    assert "t_start_perf" in snap and "phases" in snap
+    assert rec.lookup("req-never") is None
+    stats = rec.stats()
+    assert stats["indexed"] == 6
+    assert stats["index_capacity"] == 8
+
+
+def test_flight_recorder_lookup_evicts_fifo():
+    rec = FlightRecorder(capacity=4, index_capacity=3)
+    for i in range(5):
+        rec.record(_finished_trace(f"req-{i}"))
+    assert rec.lookup("req-0") is None  # evicted
+    assert rec.lookup("req-1") is None  # evicted
+    assert rec.lookup("req-4") is not None
+    with pytest.raises(ValueError):
+        FlightRecorder(index_capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# exposition merge math (goldens)
+# ---------------------------------------------------------------------------
+
+
+PAGE_R1 = """\
+# HELP stub_requests_total Requests served.
+# TYPE stub_requests_total counter
+stub_requests_total{outcome="ok"} 10
+stub_requests_total{outcome="shed"} 2
+# HELP stub_queue_depth Admission queue depth.
+# TYPE stub_queue_depth gauge
+stub_queue_depth 3
+# HELP stub_latency_seconds Latency.
+# TYPE stub_latency_seconds histogram
+stub_latency_seconds_bucket{le="0.01"} 4
+stub_latency_seconds_bucket{le="0.1"} 9
+stub_latency_seconds_bucket{le="+Inf"} 10
+stub_latency_seconds_sum 0.5
+stub_latency_seconds_count 10
+"""
+
+PAGE_R2 = """\
+# HELP stub_requests_total Requests served.
+# TYPE stub_requests_total counter
+stub_requests_total{outcome="ok"} 7
+# HELP stub_queue_depth Admission queue depth.
+# TYPE stub_queue_depth gauge
+stub_queue_depth 5
+# HELP stub_latency_seconds Latency.
+# TYPE stub_latency_seconds histogram
+stub_latency_seconds_bucket{le="0.01"} 1
+stub_latency_seconds_bucket{le="0.1"} 6
+stub_latency_seconds_bucket{le="+Inf"} 7
+stub_latency_seconds_sum 0.8
+stub_latency_seconds_count 7
+"""
+
+
+def _merge(pages, **kw):
+    parsed = {
+        rid: fleetmetrics.parse_exposition(text)
+        for rid, text in pages.items()
+    }
+    return fleetmetrics.merge_expositions(parsed, **kw)
+
+
+def test_merge_counter_sum_and_gauge_relabel_goldens():
+    merged, rejected = _merge({"r1": PAGE_R1, "r2": PAGE_R2})
+    assert rejected == []
+
+    counters = merged["stub_requests_total"]["series"]
+    assert counters[(("outcome", "ok"),)] == 17  # summed across replicas
+    assert counters[(("outcome", "shed"),)] == 2  # present on r1 only
+
+    gauges = merged["stub_queue_depth"]["series"]
+    assert gauges[(("replica", "r1"),)] == 3  # re-emitted, never averaged
+    assert gauges[(("replica", "r2"),)] == 5
+
+    hist = merged["stub_latency_seconds"]["series"][()]
+    assert hist["buckets"] == {"0.01": 5, "0.1": 15, "+Inf": 17}
+    assert hist["sum"] == pytest.approx(1.3)
+    assert hist["count"] == 17
+
+    text = fleetmetrics.render_merged(merged)
+    assert validate(text) == []  # strict-validator clean
+    assert 'stub_requests_total{outcome="ok"} 17' in text
+    assert 'stub_queue_depth{replica="r2"} 5' in text
+
+
+def test_merge_rejects_bucket_mismatch():
+    page2 = PAGE_R2.replace('le="0.01"', 'le="0.025"')
+    merged, rejected = _merge({"r1": PAGE_R1, "r2": page2})
+    assert "stub_latency_seconds" not in merged
+    assert {"name": "stub_latency_seconds",
+            "reason": "bucket_mismatch"} in rejected
+    # The other families still merge — one bad family never poisons
+    # the page.
+    assert merged["stub_requests_total"]["series"][(("outcome", "ok"),)] \
+        == 17
+    assert validate(fleetmetrics.render_merged(merged)) == []
+
+
+def test_merge_rejects_kind_and_label_mismatch():
+    gauge_as_counter = (
+        "# TYPE stub_queue_depth counter\nstub_queue_depth 4\n"
+    )
+    merged, rejected = _merge({"r1": PAGE_R1, "r2": gauge_as_counter})
+    reasons = {r["name"]: r["reason"] for r in rejected}
+    assert reasons["stub_queue_depth"] == "kind_mismatch"
+
+    relabeled = (
+        "# TYPE stub_requests_total counter\n"
+        'stub_requests_total{outcome="ok",shard="a"} 1\n'
+    )
+    merged, rejected = _merge({"r1": PAGE_R1, "r2": relabeled})
+    reasons = {r["name"]: r["reason"] for r in rejected}
+    assert reasons["stub_requests_total"] == "label_mismatch"
+
+    # A replica-side gauge already labeled `replica` would collide with
+    # the label the merge appends.
+    own_replica = (
+        "# TYPE stub_queue_depth gauge\n"
+        'stub_queue_depth{replica="imposter"} 9\n'
+    )
+    merged, rejected = _merge({"r1": PAGE_R1, "r2": own_replica})
+    reasons = {r["name"]: r["reason"] for r in rejected}
+    assert reasons["stub_queue_depth"] == "label_mismatch"
+
+
+def test_merge_drops_router_owned_families():
+    merged, rejected = _merge(
+        {"r1": PAGE_R1}, drop=frozenset({"stub_queue_depth"}),
+    )
+    assert "stub_queue_depth" not in merged
+    assert {"name": "stub_queue_depth",
+            "reason": "router_owned"} in rejected
+
+
+def test_parse_exposition_escapes_and_specials():
+    page = (
+        "# TYPE weird_gauge gauge\n"
+        'weird_gauge{msg="a\\"b\\\\c\\nd"} NaN\n'
+        'weird_gauge{msg="inf"} +Inf\n'
+    )
+    fam = fleetmetrics.parse_exposition(page)["weird_gauge"]
+    key = (("msg", 'a"b\\c\nd'),)
+    assert fam["series"][key] != fam["series"][key]  # NaN
+    assert fam["series"][(("msg", "inf"),)] == float("inf")
+    # ... and the round-trip re-escapes cleanly.
+    merged, _ = _merge({"r1": page})
+    assert validate(fleetmetrics.render_merged(merged)) == []
+
+
+# ---------------------------------------------------------------------------
+# the join (synthetic, injected fetch)
+# ---------------------------------------------------------------------------
+
+
+def _router_sample(rid, replica, t0, phases, total):
+    return {
+        "request_id": rid, "status": "ok", "t_start_perf": t0,
+        "total_seconds": total, "replica": replica, "attempts": 1,
+        "phases": {
+            name: {"offset_seconds": off, "seconds": dur}
+            for name, (off, dur) in phases.items()
+        },
+    }
+
+
+def test_join_fleet_trace_offset_corrected_containment():
+    """Replica clock 5 s ahead: raw replica stamps land nowhere near the
+    router's upstream span; offset-corrected they nest inside it."""
+    skew = 5.0
+    cs = fleettrace.ClockSync()
+    cs.observe("r1", t_send=0.0, t_recv=0.0, replica_clock=skew)
+
+    t0 = 1000.0  # router admission (router clock)
+    sample = _router_sample(
+        "req-j", "r1", t0,
+        {"parse": (0.0, 0.001), "upstream": (0.001, 0.050),
+         "respond": (0.051, 0.001)},
+        total=0.052,
+    )
+    # Replica-side: starts 10 ms into the upstream window, 30 ms long —
+    # stamped on the REPLICA's (skewed) clock.
+    replica_snap = {
+        "request_id": "req-j", "status": "ok",
+        "t_start_perf": t0 + 0.011 + skew, "total_seconds": 0.030,
+        "phases": {
+            "parse": {"offset_seconds": 0.0, "seconds": 0.002},
+            "device_compute": {"offset_seconds": 0.002, "seconds": 0.020},
+            "respond": {"offset_seconds": 0.028, "seconds": 0.002},
+        },
+        "path": "device",
+    }
+
+    def fetch(url, rid, timeout_s):
+        assert url == "http://rep:1" and rid == "req-j"
+        return replica_snap, "ok"
+
+    export = fleettrace.join_fleet_trace(
+        [sample], {"r1": "http://rep:1"}, cs, fetch=fetch,
+    )
+    other = export["otherData"]
+    assert other["results"]["joined"] == 1
+    assert other["containment"]["contained"] == 1
+    assert other["containment"]["ratio"] == 1.0
+
+    by_name = {}
+    for ev in export["traceEvents"]:
+        if ev.get("ph") == "X":
+            by_name[ev["name"]] = ev
+    up = by_name["upstream"]
+    rep = by_name["replica r1"]
+    # Same lane (the viewers nest positionally on one tid)...
+    assert rep["tid"] == up["tid"]
+    # ...and the replica interval sits inside upstream on the router's
+    # timeline despite the 5 s clock skew.
+    assert rep["ts"] >= up["ts"]
+    assert rep["ts"] + rep["dur"] <= up["ts"] + up["dur"]
+    assert by_name["device_compute"]["dur"] == pytest.approx(20_000, rel=0.01)
+    assert rep["args"]["offset_ms"] == pytest.approx(5000.0, abs=1.0)
+
+
+def test_join_fleet_trace_counts_misses_explicitly():
+    cs = fleettrace.ClockSync()
+    cs.observe("r1", 0.0, 0.0, 0.0)
+    samples = [
+        _router_sample("req-a", None, 1.0, {}, 0.01),      # no replica meta
+        _router_sample("req-b", "ghost", 1.1, {}, 0.01),   # unknown replica
+        _router_sample("req-c", "r2", 1.2, {}, 0.01),      # no offset yet
+        _router_sample("req-d", "r1", 1.3, {}, 0.01),      # 404 at replica
+    ]
+
+    def fetch(url, rid, timeout_s):
+        return None, "no_replica_trace"
+
+    export = fleettrace.join_fleet_trace(
+        samples, {"r1": "http://rep:1", "r2": "http://rep:2"}, cs,
+        fetch=fetch,
+    )
+    r = export["otherData"]["results"]
+    assert r["no_replica_meta"] == 1
+    assert r["unknown_replica"] == 1
+    assert r["no_offset"] == 1
+    assert r["no_replica_trace"] == 1
+    assert r["joined"] == 0
+    assert export["otherData"]["containment"]["ratio"] is None
+
+
+# ---------------------------------------------------------------------------
+# scraper staleness (real HTTP, stub registry)
+# ---------------------------------------------------------------------------
+
+
+class _PageApp:
+    def __init__(self, text):
+        self.text = text
+
+    def handle_request(self, req, rsp):
+        if req.path == "/metrics":
+            rsp.send(200, self.text.encode(), "text/plain; version=0.0.4")
+        else:
+            rsp.send_json(404, {"error": "nope"})
+
+    def handle_protocol_error(self, exc, rsp):
+        rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+
+class _StubRegistry:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def snapshot(self):
+        return self.rows
+
+
+def test_fleet_scraper_marks_stale_replicas():
+    httpd = EventLoopHttpServer(("127.0.0.1", 0), _PageApp(PAGE_R1))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        live = f"http://127.0.0.1:{httpd.server_address[1]}"
+        dead = "http://127.0.0.1:1"  # nothing listens here
+        scraper = fleetmetrics.FleetScraper(
+            _StubRegistry([
+                {"id": "alive", "url": live, "in_rotation": True},
+                {"id": "gone", "url": dead, "in_rotation": True},
+                {"id": "benched", "url": dead, "in_rotation": False},
+            ]),
+            timeout_s=2.0,
+        )
+        text, summary = scraper.render_fleet_page()
+        # The dead replica is marked, never silently omitted; the
+        # benched one is not in rotation, so it is not scraped at all.
+        assert summary["scraped"] == ["alive"]
+        assert summary["stale"] == ["gone"]
+        assert validate(text) == []
+        assert 'fleet_scrape_stale{replica="gone"} 1' in text
+        assert 'fleet_scrape_stale{replica="alive"} 0' in text
+        assert 'stub_requests_total{outcome="ok"} 10' in text
+    finally:
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# router endpoints end-to-end (stub replicas, real transport)
+# ---------------------------------------------------------------------------
+
+
+class _ObsStubReplica:
+    """A stub replica with the telemetry surfaces the fleet plane
+    consumes: /readyz echoing clock_perf, /metrics with a fixed page,
+    /predict recording a real trace snapshot served back via
+    /debug/requests?id=."""
+
+    def __init__(self, rid):
+        self.rid = rid
+        self.traces = {}
+        self.lock = threading.Lock()
+
+    def handle_request(self, req, rsp):
+        if req.path == "/readyz":
+            rsp.send_json(200, {
+                "ready": True, "reasons": [], "replica": self.rid,
+                "version": 1, "queue_depth": 0,
+                "clock_perf": time.perf_counter(),
+            })
+        elif req.path == "/metrics":
+            rsp.send(200, PAGE_R1.encode(), "text/plain; version=0.0.4")
+        elif req.path == "/debug/requests":
+            rid = req.query_param("id", "")
+            with self.lock:
+                snap = self.traces.get(rid)
+            if snap is None:
+                rsp.send_json(404, {"error": "not indexed"})
+            else:
+                rsp.send_json(200, {"request": snap})
+        elif req.path == "/predict":
+            t0 = time.perf_counter()
+            time.sleep(0.005)
+            t1 = time.perf_counter()
+            rid = req.get_header("x-request-id") or "anon"
+            with self.lock:
+                self.traces[rid] = {
+                    "request_id": rid, "status": "ok",
+                    "t_start_perf": round(t0, 6),
+                    "total_seconds": round(t1 - t0, 6),
+                    "phases": {
+                        "parse": {"offset_seconds": 0.0, "seconds": 0.001},
+                        "host_compute": {
+                            "offset_seconds": 0.001,
+                            "seconds": round(t1 - t0 - 0.001, 6),
+                        },
+                    },
+                    "path": "host",
+                }
+            rsp.send_json(
+                200, {"probability": 0.5},
+                headers={"X-Replica": self.rid, "X-Model-Version": "1"},
+                request_id=rid,
+            )
+        else:
+            rsp.send_json(404, {"error": "nope"})
+
+    def handle_protocol_error(self, exc, rsp):
+        rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_router_fleet_telemetry_endpoints():
+    stubs, httpds, members = [], [], []
+    for i in range(2):
+        stub = _ObsStubReplica(f"r{i + 1}")
+        httpd = EventLoopHttpServer(("127.0.0.1", 0), stub)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        stubs.append(stub)
+        httpds.append(httpd)
+        members.append(
+            (stub.rid, f"http://127.0.0.1:{httpd.server_address[1]}")
+        )
+    router = make_router(
+        port=0, replicas=members, probe_interval_s=0.1,
+        request_timeout_s=5.0,
+    ).start_background()
+    try:
+        deadline = time.monotonic() + 10
+        while router.registry.ready_count() < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert router.registry.ready_count() == 2
+        base = f"http://{router.address[0]}:{router.address[1]}"
+
+        # Wait for a clock-offset estimate on every replica (one probe
+        # tick each).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+            router.clock_sync.offset_s(rid) is None for rid, _ in members
+        ):
+            time.sleep(0.02)
+
+        ids = []
+        for i in range(8):
+            rid = f"obs-e2e-{i}"
+            req = urllib.request.Request(
+                base + "/predict", data=b'{"x": 1}',
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": rid},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                assert resp.status == 200
+            ids.append(rid)
+
+        # -- /debug/requests?id= on the router ---------------------------
+        status, body = _get_json(
+            base + f"/debug/requests?id={ids[0]}"
+        )
+        assert status == 200
+        assert body["request"]["request_id"] == ids[0]
+        assert body["request"]["replica"] in ("r1", "r2")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get_json(base + "/debug/requests?id=never-seen")
+        assert exc_info.value.code == 404
+        assert "error" in json.loads(exc_info.value.read())
+
+        # -- /fleet/metrics ----------------------------------------------
+        with urllib.request.urlopen(
+            base + "/fleet/metrics", timeout=10.0
+        ) as resp:
+            page = resp.read().decode()
+        assert validate(page) == []
+        # Merged replica families, summed across the two stubs...
+        assert 'stub_requests_total{outcome="ok"} 20' in page
+        # ...the router's own families appended...
+        assert "fleet_requests_total" in page
+        # ...including the fleet-level SLO fed from the router's stream
+        # and the scrape-health families updated by this very scrape.
+        assert 'fleet_slo_requests_total{slo="availability"}' in page
+        assert 'fleet_scrape_stale{replica="r1"} 0' in page
+
+        # -- /fleet/trace -------------------------------------------------
+        status, export = _get_json(base + "/fleet/trace?n=64")
+        assert status == 200
+        other = export["otherData"]
+        assert other["joined"] >= 1
+        assert other["containment"]["contained"] == other["joined"]
+        cats = {
+            ev.get("cat") for ev in export["traceEvents"]
+            if ev.get("ph") == "X"
+        }
+        assert {"router", "replica"} <= cats
+    finally:
+        router.shutdown()
+        for h in httpds:
+            h.server_close()

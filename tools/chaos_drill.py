@@ -452,6 +452,30 @@ def run_fleet_drill(args) -> int:
         traffic = _Traffic(base, dict(EXAMPLE_PATIENT), goldens).start()
         time.sleep(2.0)  # a baseline window of healthy two-replica traffic
 
+        # Cross-process joined timeline, captured while both replicas
+        # are healthy (the kill/deploy scenarios below legitimately
+        # leave unreachable-replica samples in the router's ring): the
+        # router fetches each tail-sampled request's replica-side trace
+        # by id and offset-corrects it into the upstream span.
+        with urllib.request.urlopen(
+            base + "/fleet/trace?n=256", timeout=HARD_TIMEOUT_S
+        ) as resp:
+            fleet_trace = json.loads(resp.read())
+        trace_other = fleet_trace["otherData"]
+        assert trace_other["joined"] >= 1, (
+            "no cross-hop joined trace in the healthy window",
+            trace_other["results"],
+        )
+        assert trace_other["containment"]["contained"] >= 1, (
+            "no joined trace showed replica-inside-upstream containment",
+            trace_other["containment"],
+        )
+        if args.fleet_trace_out:
+            with open(args.fleet_trace_out, "w") as f:
+                json.dump(fleet_trace, f)
+            print(f"fleet trace written to {args.fleet_trace_out}",
+                  file=sys.stderr)
+
         # --- scenario: kill_replica ---------------------------------------
         t0 = time.monotonic()
         procs["r1"].send_signal(signal.SIGKILL)
@@ -614,6 +638,43 @@ def run_fleet_drill(args) -> int:
                 f.write(page)
             print(f"router metrics written to {args.metrics_out}",
                   file=sys.stderr)
+
+        # Aggregated fleet exposition: in-rotation replicas scraped and
+        # merged (counters summed, gauges replica-labeled, histograms
+        # bucket-merged), router-owned families appended — one page,
+        # strict-validator clean, with every replica either merged or
+        # marked stale on the page itself.
+        with urllib.request.urlopen(
+            base + "/fleet/metrics", timeout=HARD_TIMEOUT_S
+        ) as resp:
+            fleet_page = resp.read().decode()
+        errs = validate(fleet_page)
+        assert not errs, (
+            f"/fleet/metrics failed strict validation: {errs[:5]}"
+        )
+        for family in ("serve_requests_total", "fleet_scrape_stale",
+                       "fleet_slo_requests_total",
+                       "fleet_clock_offset_ms"):
+            assert family in fleet_page, (
+                f"{family} missing from /fleet/metrics"
+            )
+        if args.fleet_metrics_out:
+            with open(args.fleet_metrics_out, "w") as f:
+                f.write(fleet_page)
+            print(
+                f"fleet metrics written to {args.fleet_metrics_out}",
+                file=sys.stderr,
+            )
+        fleet_telemetry = {
+            "trace": {
+                "requests": trace_other["requests"],
+                "joined": trace_other["joined"],
+                "results": trace_other["results"],
+                "containment": trace_other["containment"],
+                "clock_offsets": trace_other["clock_offsets"],
+            },
+            "fleet_metrics_validated": True,
+        }
     finally:
         if traffic is not None:
             traffic.stop()
@@ -671,6 +732,7 @@ def run_fleet_drill(args) -> int:
         },
         "traffic_total": overall,
         "scenarios": scenarios,
+        "fleet_telemetry": fleet_telemetry,
         "router_journal_kinds": sorted(k for k in kinds if k),
         "replica_journal_kinds": sorted(
             k for k in replica_kinds if k
@@ -1046,6 +1108,18 @@ def main(argv=None) -> int:
         "--metrics-out", default=None,
         help="(--fleet/--surge) write the router's final /metrics page "
         "here after strict validation",
+    )
+    ap.add_argument(
+        "--fleet-metrics-out", default=None,
+        help="(--fleet) write the aggregated /fleet/metrics page "
+        "(replicas scraped + merged + router families) here after "
+        "strict validation",
+    )
+    ap.add_argument(
+        "--fleet-trace-out", default=None,
+        help="(--fleet) write the cross-process joined /fleet/trace "
+        "export (Perfetto-loadable) captured during the healthy "
+        "two-replica window here",
     )
     ap.add_argument(
         "--surge", action="store_true",

@@ -366,10 +366,13 @@ class _Tally:
         self.replicas: dict[str, int] = {}
         self.versions: dict[str, dict] = {}
         self.replica_versions: dict[str, dict[str, int]] = {}
-        # (latency_ms, request_id, status) for every id-carrying reply;
-        # reduced to the n_worst slowest at artifact time. One tuple per
-        # request is fine for bench durations (minutes, not days).
-        self.ided: list[tuple[float, str, str]] = []
+        # (latency_ms, request_id, status, replica, version, path) for
+        # every id-carrying reply; reduced to the n_worst slowest at
+        # artifact time. One tuple per request is fine for bench
+        # durations (minutes, not days).
+        self.ided: list[
+            tuple[float, str, str, str | None, str | None, str | None]
+        ] = []
 
     def record(
         self, status: str, latency_ms: float, request_id: str | None = None,
@@ -402,7 +405,9 @@ class _Tally:
             else:
                 self.n_err += 1
             if request_id:
-                self.ided.append((latency_ms, request_id, status))
+                self.ided.append(
+                    (latency_ms, request_id, status, replica, version, path)
+                )
 
     def fleet_block(self) -> dict | None:
         """The artifact's ``fleet`` block: ok-reply distribution over the
@@ -453,15 +458,25 @@ class _Tally:
 
     def worst_requests(self) -> list[dict]:
         """The slowest server-identified requests — the join keys against
-        the server's /debug/requests tail samples."""
+        the server's /debug/requests tail samples. Each entry carries the
+        per-reply identity echoes (``X-Replica`` / ``X-Model-Version`` /
+        ``X-Serve-Path``, None when the server predates them) so a
+        client-observed tail request keys directly into the fleet trace:
+        which replica served it, on which checkpoint, via which engine."""
         with self.lock:
-            worst = sorted(self.ided, reverse=True)[: self.n_worst]
+            # Key on latency alone: trailing tuple fields may be None,
+            # and a latency tie must not compare them.
+            worst = sorted(
+                self.ided, key=lambda t: t[0], reverse=True,
+            )[: self.n_worst]
         return [
             {
                 "request_id": rid, "status": status,
                 "latency_ms": round(ms, 3),
+                "replica": replica, "model_version": version,
+                "serve_path": path,
             }
-            for ms, rid, status in worst
+            for ms, rid, status, replica, version, path in worst
         ]
 
 

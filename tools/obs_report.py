@@ -943,6 +943,72 @@ def _section_join(rep: Report, bench: dict | None, requests: dict | None):
     )
 
 
+def _section_fleet_telemetry(
+    rep: Report, trace: dict | None, fleet_page: str | None,
+):
+    """The "Fleet telemetry" section (docs/OBSERVABILITY.md): the
+    cross-process joined timeline's accounting (join results per
+    tail-sampled request, offset-corrected containment, the live clock
+    offsets) and the aggregated /fleet/metrics page's scrape-health and
+    fleet-SLO lines — the evidence that the fleet-scoped surfaces were
+    produced by a real multi-process run, not assembled by hand."""
+    if trace is None and fleet_page is None:
+        return
+    rep.h("Fleet telemetry")
+    if trace is not None:
+        other = trace.get("otherData") or {}
+        results = other.get("results") or {}
+        containment = other.get("containment") or {}
+        joined = other.get("joined")
+        n = other.get("requests")
+        rep.kv(
+            "cross-process join",
+            f"{joined}/{n} tail-sampled router requests joined with "
+            "their replica-side phases",
+        )
+        misses = {k: v for k, v in results.items()
+                  if k != "joined" and v}
+        rep.kv(
+            "join misses",
+            ", ".join(f"{k}={v}" for k, v in sorted(misses.items()))
+            or "none",
+        )
+        rep.kv(
+            "offset-corrected containment",
+            f"{containment.get('contained')}/{joined} replica spans "
+            f"inside their upstream span (ratio "
+            f"{containment.get('ratio')}, slack "
+            f"{containment.get('slack_ms')} ms, worst excess "
+            f"{containment.get('worst_excess_ms')} ms)",
+        )
+        offsets = other.get("clock_offsets") or {}
+        if offsets:
+            rep.table(
+                ("replica", "offset (ms)", "probe rtt (ms)", "samples"),
+                [(rid, o.get("offset_ms"), o.get("rtt_ms"),
+                  o.get("samples"))
+                 for rid, o in sorted(offsets.items())],
+            )
+    if fleet_page is not None:
+        wanted = ("fleet_scrape_stale", "fleet_slo_good_ratio",
+                  "fleet_slo_burn_rate",
+                  "fleet_slo_error_budget_remaining_ratio")
+        lines = [
+            ln for ln in fleet_page.splitlines()
+            if ln.startswith(wanted)
+        ]
+        rep.kv(
+            "aggregated /fleet/metrics",
+            f"{sum(1 for ln in fleet_page.splitlines() if ln.startswith('# TYPE'))} "
+            "families on one strict-validator-clean page",
+        )
+        if lines:
+            rep.lines.append("")
+            rep.lines.append("```")
+            rep.lines.extend(lines)
+            rep.lines.append("```")
+
+
 def _section_static_analysis(rep: Report, gc: dict | None):
     """The last graftcheck run (docs/ANALYSIS.md), from its --json-out
     artifact: rules run, live findings, baseline debt and its oldest
@@ -1072,6 +1138,17 @@ def main(argv=None) -> int:
         "--url then points at the router",
     )
     ap.add_argument(
+        "--fleet-trace",
+        help="a /fleet/trace export (chaos_drill --fleet-trace-out): "
+        "renders the 'Fleet telemetry' join/containment accounting",
+    )
+    ap.add_argument(
+        "--fleet-metrics",
+        help="an aggregated /fleet/metrics page (chaos_drill "
+        "--fleet-metrics-out): renders its scrape-health and fleet-SLO "
+        "lines in the 'Fleet telemetry' section",
+    )
+    ap.add_argument(
         "--learn", action="store_true",
         help="render the 'Continual learning' section (trigger decisions "
         "+ refit stage timings + shadow verdict + promotion/deploy arc + "
@@ -1096,7 +1173,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not (args.url or args.journal or args.metrics or args.requests
             or args.quality or args.score_bench or args.graftcheck
-            or args.coldstart):
+            or args.coldstart or args.fleet_trace or args.fleet_metrics):
         ap.error("nothing to report on: give --url and/or input files")
 
     health = metrics = requests = quality = fleet_replicas = None
@@ -1136,6 +1213,11 @@ def main(argv=None) -> int:
         events.sort(key=lambda e: e.get("ts") or "")
     bench = _load_json(args.bench) if args.bench else None
     score_bench = _load_json(args.score_bench) if args.score_bench else None
+    fleet_trace = _load_json(args.fleet_trace) if args.fleet_trace else None
+    fleet_page = None
+    if args.fleet_metrics:
+        with open(args.fleet_metrics) as f:
+            fleet_page = f.read()
 
     rep = Report()
     _section_run(rep, manifest, health)
@@ -1165,6 +1247,7 @@ def main(argv=None) -> int:
         _section_fleet(
             rep, fleet_replicas, (metrics or {}).get("runtime"), events,
         )
+        _section_fleet_telemetry(rep, fleet_trace, fleet_page)
         # The elastic-fleet timeline (autoscaler + lifecycle + rotation
         # events joined) renders whenever the journal set carries it.
         _section_autoscale(rep, events)
@@ -1193,6 +1276,7 @@ def main(argv=None) -> int:
         slos = (requests or {}).get("slo")
         _section_slo(rep, slos)
         _section_quality(rep, quality, events, bench)
+        _section_fleet_telemetry(rep, fleet_trace, fleet_page)
         _section_tail(rep, requests, n=args.tail)
         if args.journal:
             _section_journal(rep, events)
