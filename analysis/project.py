@@ -36,6 +36,12 @@ JAXFREE = (
     # the TPU plugin (obs/journal.py module docstring).
     f"{PACKAGE}.obs.journal",
     f"{PACKAGE}.obs.registry",
+    # The alerting plane rides router and replica processes alike; the
+    # router side must stay accelerator-free (docs/OBSERVABILITY.md
+    # "Alerting & incidents").
+    f"{PACKAGE}.obs.timeseries",
+    f"{PACKAGE}.obs.alerts",
+    f"{PACKAGE}.obs.incident",
     # Bulk-score input parsing: the reader side of the score pipeline
     # (host-only parse/validate/quarantine) stays importable without jax.
     f"{PACKAGE}.score.reader",
@@ -46,6 +52,7 @@ JAXFREE = (
     "tools.validate_metrics",
     "tools.fleet_bench",
     "tools.graftcheck",
+    "tools.incident_report",
 )
 
 

@@ -332,6 +332,16 @@ def cmd_serve(args) -> int:
             "in-place deploy would reach only one SO_REUSEPORT worker; "
             "deploy multi-worker replicas by rolling restart instead"
         )
+    if args.workers > 1 and args.incident_dir:
+        # N worker processes sharing one bundle directory would race the
+        # timestamped dir names and each other's retention pruning; the
+        # incident recorder stays a single-worker feature (alerts and
+        # history themselves are per-process and stay on).
+        raise SystemExit(
+            "--incident-dir is not supported with --workers > 1: the "
+            "capture directory is single-writer (run one worker, or "
+            "capture at the router)"
+        )
     if args.workers > 1 and worker_id is None:
         return _run_multiworker(args)
     buckets = tuple(int(b) for b in args.buckets.split(","))
@@ -377,6 +387,10 @@ def cmd_serve(args) -> int:
         # — the bench-reproducibility knob r11 flagged, journaled so an
         # artifact can state the pool it ran under.
         "xla_intra_op_threads": args._xla_threads,
+        "history_interval_s": args.history_interval,
+        "alert_rules": args.alert_rules,
+        "no_alerts": args.no_alerts,
+        "incident_dir": args.incident_dir,
     }, sort_keys=True)
     extra = {}
     if worker_id is not None:
@@ -481,6 +495,17 @@ def _run_multiworker(args) -> int:
     return rc
 
 
+def _load_alert_rules(path):
+    """Parse a ``--alert-rules`` JSON file, turning the rule engine's
+    eager validation errors into the CLI's usage-error exit."""
+    from machine_learning_replications_tpu.obs import alerts
+
+    try:
+        return alerts.load_rules(path)
+    except (OSError, ValueError) as exc:
+        raise SystemExit(f"--alert-rules: {exc}")
+
+
 def _run_serve(args, buckets) -> int:
     import signal
 
@@ -579,6 +604,15 @@ def _run_serve(args, buckets) -> int:
         admin_endpoint=args.admin_endpoint,
         aot_bundle=aot_bundle,
         use_aot=not args.no_aot,
+        history_interval_s=args.history_interval,
+        alert_rules=(
+            _load_alert_rules(args.alert_rules) if args.alert_rules
+            else None
+        ),
+        alerts_enabled=not args.no_alerts,
+        incident_dir=args.incident_dir,
+        incident_min_interval_s=args.incident_min_interval,
+        incident_retention=args.incident_retention,
     )
     # Serving-process GC hygiene (the Instagram pre-fork trick): the
     # warm startup heap — jax, XLA executables, the uploaded ensemble —
@@ -899,6 +933,15 @@ def _run_fleet_router(args) -> int:
         capture_dir=args.capture,
         capture_rows_per_shard=args.capture_rows_per_shard,
         capture_max_shards=args.capture_max_shards,
+        history_interval_s=args.history_interval,
+        alert_rules=(
+            _load_alert_rules(args.alert_rules) if args.alert_rules
+            else None
+        ),
+        alerts_enabled=not args.no_alerts,
+        incident_dir=args.incident_dir,
+        incident_min_interval_s=args.incident_min_interval,
+        incident_retention=args.incident_retention,
     )
     host, port = handle.address
     who = f" (worker {worker_id})" if worker_id is not None else ""
@@ -947,6 +990,12 @@ def _run_router_multiworker(args) -> int:
         # stays a single-worker feature.
         raise SystemExit("--capture is not supported with --workers > 1 "
                          "(run a single-worker capture router)")
+    if args.incident_dir:
+        # Same single-writer contract as --capture: timestamped bundle
+        # dirs and retention pruning from N processes would race.
+        raise SystemExit("--incident-dir is not supported with "
+                         "--workers > 1 (run a single-worker alerting "
+                         "router)")
     children: list[int] = []
     for k in range(args.workers):
         pid = os.fork()
@@ -1077,6 +1126,8 @@ def _run_fleet_autoscale(args) -> int:
                 in_latency_ms=args.in_latency_ms,
                 in_shed_rate=args.in_shed_rate,
                 in_burn_rate=args.in_burn_rate,
+                out_alerts_active=args.out_alerts_active,
+                in_alerts_active=args.in_alerts_active,
             ),
             min_replicas=args.min,
             max_replicas=args.max,
@@ -1525,6 +1576,43 @@ def build_parser() -> argparse.ArgumentParser:
             "structured stage/checkpoint/flush events",
         )
 
+    def add_alerting_flags(p, role: str):
+        p.add_argument(
+            "--history-interval", type=float, default=10.0,
+            metavar="SECONDS",
+            help="in-process metrics history sampling interval for "
+            "/debug/history and alert evaluation (0 disables the whole "
+            "history/alerting plane; docs/OBSERVABILITY.md)",
+        )
+        p.add_argument(
+            "--alert-rules", default=None, metavar="FILE",
+            help="JSON alert-rule file (list of rule specs) replacing "
+            f"the built-in {role} defaults; rules evaluate against the "
+            "sampled history every tick",
+        )
+        p.add_argument(
+            "--no-alerts", action="store_true",
+            help="sample history but evaluate no alert rules "
+            "(/debug/history stays available, alert state is empty)",
+        )
+        p.add_argument(
+            "--incident-dir", default=None, metavar="DIR",
+            help="capture an incident bundle (alert + history window + "
+            "request tail + journal tail) into DIR when a rule fires; "
+            "off by default — firing alerts then only journal",
+        )
+        p.add_argument(
+            "--incident-min-interval", type=float, default=60.0,
+            metavar="SECONDS",
+            help="minimum seconds between incident captures (rate limit "
+            "so a flapping rule cannot fill the disk)",
+        )
+        p.add_argument(
+            "--incident-retention", type=int, default=8,
+            help="complete incident bundles retained in --incident-dir "
+            "(oldest pruned first)",
+        )
+
     def add_mesh_flags(p, what: str):
         p.add_argument(
             "--mesh", default=None,
@@ -1765,6 +1853,7 @@ def build_parser() -> argparse.ArgumentParser:
         "reason /debug/faults is",
     )
     v.add_argument("--verbose", action="store_true", help="log each request")
+    add_alerting_flags(v, "replica")
     add_obs_flags(v)
     v.set_defaults(fn=cmd_serve)
 
@@ -1849,6 +1938,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="capture shards retained (older ones are unlinked; the "
         "window is ~rows-per-shard x max-shards recent rows)",
     )
+    add_alerting_flags(fr, "router")
     fr.add_argument("--verbose", action="store_true")
     fr.set_defaults(fn=cmd_fleet)
     fd = fsub.add_parser(
@@ -1938,6 +2028,17 @@ def build_parser() -> argparse.ArgumentParser:
     fa.add_argument("--in-latency-ms", type=float, default=50.0)
     fa.add_argument("--in-shed-rate", type=float, default=0.0)
     fa.add_argument("--in-burn-rate", type=float, default=1.0)
+    fa.add_argument(
+        "--out-alerts-active", type=float, default=None,
+        help="scale-out when this many router alert rules are firing "
+        "(/fleet/alerts; default None keeps the alert plane out of the "
+        "control loop — the reading is journaled either way)",
+    )
+    fa.add_argument(
+        "--in-alerts-active", type=float, default=None,
+        help="scale-in twin of --out-alerts-active (None: firing "
+        "alerts never block a scale-in)",
+    )
     fa.add_argument(
         "--ready-deadline", type=float, default=300.0,
         help="seconds a spawned replica may take to answer /readyz "

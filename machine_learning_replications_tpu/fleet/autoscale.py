@@ -21,6 +21,13 @@ Signals, per poll (all best-effort; an unreachable surface is a
                     is the worst overload there is)
   ``burn_rate``     max SLO burn rate across replicas (``slo_burn_rate``
                     from each replica's ``/metrics?format=json``)
+  ``alerts_active`` count of alert rules currently *firing* on the
+                    router's own alert engine (``/fleet/alerts``;
+                    docs/OBSERVABILITY.md "Alerting & incidents").
+                    Disabled by default (``None`` thresholds) — wire
+                    ``out_alerts_active=1`` to make any page-severity
+                    firing alert a scale-out vote; either way the
+                    reading is journaled with every decision
 
 Policy (``AutoscalePolicy``), tuned against the failure modes a naive
 "scale on threshold" loop has:
@@ -84,7 +91,10 @@ AUTOSCALE_DESIRED = REGISTRY.gauge(
 for _k in ("breach", "idle"):
     AUTOSCALE_STREAK.set(0.0, kind=_k)
 
-SIGNALS = ("queue_depth", "latency_ms", "shed_rate", "burn_rate")
+SIGNALS = (
+    "queue_depth", "latency_ms", "shed_rate", "burn_rate",
+    "alerts_active",
+)
 
 
 class AutoscaleThresholds:
@@ -99,22 +109,30 @@ class AutoscaleThresholds:
         out_latency_ms: float | None = 250.0,
         out_shed_rate: float | None = 0.02,
         out_burn_rate: float | None = 4.0,
+        out_alerts_active: float | None = None,
         in_queue_depth: float | None = 1.0,
         in_latency_ms: float | None = 50.0,
         in_shed_rate: float | None = 0.0,
         in_burn_rate: float | None = 1.0,
+        in_alerts_active: float | None = None,
     ) -> None:
         self.out = {
             "queue_depth": out_queue_depth,
             "latency_ms": out_latency_ms,
             "shed_rate": out_shed_rate,
             "burn_rate": out_burn_rate,
+            # Off by default: the alert plane is an operator surface
+            # first; opting it into the control loop is a deliberate
+            # coupling (a paging alert then both wakes a human AND adds
+            # capacity).
+            "alerts_active": out_alerts_active,
         }
         self.scale_in = {
             "queue_depth": in_queue_depth,
             "latency_ms": in_latency_ms,
             "shed_rate": in_shed_rate,
             "burn_rate": in_burn_rate,
+            "alerts_active": in_alerts_active,
         }
         for name in SIGNALS:
             hi, lo = self.out[name], self.scale_in[name]
@@ -355,6 +373,23 @@ class AutoscaleDaemon:
             return signals
         runtime = page.get("runtime") or {}
         replicas = page.get("replicas") or []
+
+        # The router's own alert engine (docs/OBSERVABILITY.md): count
+        # rules in the *firing* state — a resolving alert's condition
+        # has already cleared and must not keep voting for capacity. A
+        # router without the alert plane (disabled, pre-alerting) just
+        # leaves the signal None.
+        try:
+            alerts_page = _fetch_json(
+                self.router_url + "/fleet/alerts", self.poll_timeout_s
+            )
+            if alerts_page.get("enabled"):
+                signals["alerts_active"] = float(sum(
+                    1 for a in alerts_page.get("active") or []
+                    if a.get("state") == "firing"
+                ))
+        except Exception:
+            pass
 
         outcomes = runtime.get("fleet_requests_total")
         if isinstance(outcomes, dict):

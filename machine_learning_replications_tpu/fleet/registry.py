@@ -219,8 +219,27 @@ class ReplicaRegistry:
         self._replicas: dict[str, Replica] = {}
         self._rng = rng or random.Random()
         self._pick_seq = 0  # monotonic pick stamp (LRU tie-break)
+        self._retire_listeners: list = []
 
     # -- membership ---------------------------------------------------------
+
+    def add_retire_listener(self, fn) -> None:
+        """``fn(replica_id)`` runs whenever a replica's *process* is
+        gone for good — deregistration, or replacement by a same-id
+        re-registration with a new url. The telemetry plane hooks this
+        to retire the replica's per-replica gauge series
+        (``fleet_scrape_stale``, ``fleet_clock_offset_ms``) instead of
+        letting them linger forever at their last value. Listeners run
+        outside the lock; exceptions are swallowed (telemetry hygiene
+        must never break membership)."""
+        self._retire_listeners.append(fn)
+
+    def _notify_retire(self, replica_id: str) -> None:
+        for fn in self._retire_listeners:
+            try:
+                fn(replica_id)
+            except Exception:
+                pass
 
     def register(self, replica_id: str, url: str) -> dict:
         """Add (or re-add) a replica. Re-registration with the same id is
@@ -241,6 +260,10 @@ class ReplicaRegistry:
             )
             self._replicas[replica_id] = rep = Replica(replica_id, url)
             self._refresh_gauge_locked()
+        if old is not None:
+            # The process behind the id was replaced: the OLD process's
+            # per-replica series must not survive as the new one's.
+            self._notify_retire(replica_id)
         if replaced_in:
             FLEET_ROTATIONS.inc(direction="out")
             journal.event(
@@ -259,6 +282,7 @@ class ReplicaRegistry:
                 return False
             was_in = rep.state == READY and not rep.held
             self._refresh_gauge_locked()
+        self._notify_retire(replica_id)
         if was_in:
             FLEET_ROTATIONS.inc(direction="out")
         journal.event(

@@ -86,10 +86,13 @@ import time
 import urllib.parse
 
 from machine_learning_replications_tpu.obs import (
+    alerts as obs_alerts,
     fleetmetrics,
     fleettrace,
+    incident as obs_incident,
     journal,
     reqtrace,
+    timeseries as obs_timeseries,
 )
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.fleet.health import HealthProber
@@ -723,6 +726,14 @@ class _RouterApp:
                     self.upstream.stats()
                     if self.upstream is not None else None
                 ),
+                # Alerting plane summary (obs.alerts): rule counts and
+                # the worst firing severity, so the probe every
+                # supervisor already polls carries "is anything paging".
+                # None when the alert engine is disabled.
+                "alerts": (
+                    self.handle.alerts.summary()
+                    if self.handle.alerts is not None else None
+                ),
                 "uptime_seconds": round(
                     time.monotonic() - self.started_monotonic, 3
                 ),
@@ -761,6 +772,43 @@ class _RouterApp:
                 "stats": self.recorder.stats(),
                 "requests": self.recorder.snapshot(n),
             })
+        elif path == "/fleet/alerts":
+            # In-memory read — inline is fine (the engine state is a
+            # handful of dicts under no I/O).
+            if self.handle.alerts is None:
+                rsp.send_json(200, {
+                    "enabled": False, "active": [], "summary": None,
+                })
+                return
+            snap = self.handle.alerts.snapshot()
+            rsp.send_json(200, {
+                "enabled": True,
+                "active": snap["active"],
+                "summary": self.handle.alerts.summary(),
+                "rules": snap["rules"],
+            })
+        elif path == "/debug/history":
+            store = self.handle.history
+            if store is None:
+                rsp.send_json(200, {"enabled": False, "families": {}})
+                return
+            family = req.query_param("family", "")
+            if not family:
+                rsp.send_json(200, {
+                    "enabled": True,
+                    "families": store.families(),
+                    "stats": store.stats(),
+                })
+                return
+            try:
+                window = float(req.query_param("window", "0") or 0)
+            except ValueError:
+                rsp.send_json(400, {"error": "window must be a number"})
+                return
+            now = time.time()  # graftcheck: disable=monotonic-clock
+            rsp.send_json(200, store.query(
+                family, window if window > 0 else None, now,
+            ))
         elif path == "/fleet/metrics":
             # The scrape blocks up to timeout_s per replica — on its own
             # short-lived thread (the /debug/profile pattern), never the
@@ -952,6 +1000,14 @@ class RouterHandle:
         self.capture_feed: _CaptureFeed | None = (
             _CaptureFeed(capture) if capture is not None else None
         )
+        # The alerting plane (obs.timeseries / obs.alerts /
+        # obs.incident): history ring store, its sampler thread, the
+        # rule engine the sampler ticks, and the incident capturer
+        # firings trigger. All optional; wired by make_router.
+        self.history = None
+        self.sampler = None
+        self.alerts = None
+        self.incidents = None
         self.deploy_status: dict | None = None
         self._deploy_lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -977,11 +1033,15 @@ class RouterHandle:
 
     @cross_thread
     def shutdown(self) -> None:
+        if self.sampler is not None:
+            self.sampler.close()
         self.prober.close()
         self.httpd.shutdown()
         self.httpd.server_close()  # teardown closes the upstream pool too
         if self.capture_feed is not None:
             self.capture_feed.close()
+        if self.incidents is not None:
+            self.incidents.close()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
@@ -1010,6 +1070,13 @@ def make_router(
     capture_dir: str | None = None,
     capture_rows_per_shard: int = 4096,
     capture_max_shards: int = 8,
+    history_interval_s: float = 10.0,
+    history_fleet_page: bool = True,
+    alert_rules: list | None = None,
+    alerts_enabled: bool = True,
+    incident_dir: str | None = None,
+    incident_min_interval_s: float = 60.0,
+    incident_retention: int = 8,
 ) -> RouterHandle:
     """Assemble the front-door router and bind its listener (not yet
     serving — call ``serve_forever`` or ``start_background``).
@@ -1023,7 +1090,17 @@ def make_router(
     the continual-learning cohort tap (``learn.capture``): every served
     /predict body lands in a bounded rotating JSONL window there
     (~``capture_rows_per_shard`` × ``capture_max_shards`` recent rows)
-    — the retrain's data source (docs/CONTINUAL.md)."""
+    — the retrain's data source (docs/CONTINUAL.md).
+
+    ``history_interval_s`` > 0 starts the telemetry history sampler
+    (``obs.timeseries``): every tick snapshots the router's registry —
+    and, with ``history_fleet_page``, the scraped+merged fleet page —
+    into the bounded ring store behind ``GET /debug/history``.
+    ``alerts_enabled`` evaluates ``alert_rules`` (Rule objects; None →
+    ``obs.alerts.default_rules("router")``) on the same tick, served on
+    ``GET /fleet/alerts``; ``incident_dir`` additionally captures a
+    flight-recorder bundle when a rule fires (``obs.incident``,
+    docs/OBSERVABILITY.md "Alerting & incidents")."""
     registry = ReplicaRegistry(
         fail_threshold=fail_threshold,
         recover_probes=recover_probes,
@@ -1057,6 +1134,41 @@ def make_router(
             registry, timeout_s=probe_timeout_s,
         ),
     )
+    # Stale-series hygiene: a deregistered (or replaced) replica's
+    # per-replica gauge series retire with it instead of lingering at
+    # their last value (docs/OBSERVABILITY.md "Fleet telemetry").
+    registry.add_retire_listener(handle.scraper.forget)
+    registry.add_retire_listener(clock_sync.forget)
+    if history_interval_s > 0:
+        handle.history = obs_timeseries.TimeSeriesStore(
+            interval_s=history_interval_s,
+        )
+        if alerts_enabled:
+            rules = (
+                alert_rules if alert_rules is not None
+                else obs_alerts.default_rules("router")
+            )
+            handle.alerts = obs_alerts.AlertEngine(rules, handle.history)
+        if incident_dir is not None and handle.alerts is not None:
+            handle.incidents = obs_incident.IncidentCapturer(
+                incident_dir,
+                store=handle.history,
+                collectors={
+                    "requests": lambda: recorder.snapshot(64),
+                    "replicas": registry.snapshot,
+                    "metrics": REGISTRY.snapshot,
+                    "fleet_trace": lambda: fleettrace.join_fleet_trace(
+                        recorder.snapshot(64),
+                        {
+                            r["id"]: r["url"]
+                            for r in registry.snapshot()
+                        },
+                        clock_sync,
+                    ),
+                },
+                min_interval_s=incident_min_interval_s,
+                retention=incident_retention,
+            )
     app = _RouterApp(
         handle, request_timeout_s,
         hedge_s=hedge_ms / 1000.0, max_attempts=max_attempts, quiet=quiet,
@@ -1096,4 +1208,40 @@ def make_router(
     )
     if start_prober:
         prober.start()
+    if handle.history is not None:
+        scraper = handle.scraper
+        engine, capturer = handle.alerts, handle.incidents
+
+        def _collect() -> dict:
+            fams = obs_timeseries.collect_registry()
+            if history_fleet_page:
+                # The merged fleet page rides the same tick: summed
+                # counters and per-replica appended gauges become
+                # history too, and the scrape's staleness marking runs
+                # even when nobody polls /fleet/metrics — which is what
+                # keeps the fleet_replica_stale rule honest.
+                try:
+                    pages, _summary = scraper.scrape()
+                    merged, _rejected = fleetmetrics.merge_expositions(
+                        pages,
+                        drop=frozenset(
+                            fam.name for fam in REGISTRY.families()
+                        ),
+                    )
+                    fams.update(merged)
+                except Exception:
+                    pass  # absence IS the signal staleness rules watch
+            return fams
+
+        def _tick(now: float) -> None:
+            if engine is None:
+                return
+            for transition in engine.evaluate(now):
+                if capturer is not None:
+                    capturer.maybe_capture(transition)
+
+        handle.sampler = obs_timeseries.HistorySampler(
+            handle.history, _collect,
+            interval_s=history_interval_s, on_tick=_tick,
+        ).start()
     return handle

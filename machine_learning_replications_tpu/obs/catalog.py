@@ -63,6 +63,11 @@ METRICS: dict[str, tuple[str, tuple[str, ...]]] = {
     "slo_burn_rate": ("gauge", ("slo",)),
     "slo_error_budget_remaining_ratio": ("gauge", ("slo",)),
     "slo_target_ratio": ("gauge", ("slo",)),
+    "history_samples_total": ("counter", ()),
+    "history_series": ("gauge", ()),
+    "alerts_active": ("gauge", ("rule", "severity")),
+    "alerts_transitions_total": ("counter", ("rule", "transition")),
+    "incident_captures_total": ("counter", ("result",)),
     # -- resilience/ ---------------------------------------------------------
     "fault_injected_total": ("counter", ("site",)),
     "resilience_checkpoint_rollbacks_total": ("counter", ()),
@@ -177,6 +182,9 @@ EVENTS: dict[str, tuple[str, ...]] = {
     "quality_profile_missing": ("path",),
     "quality_feed_disabled": ("error",),
     "quality_feed_reenabled": ("after",),
+    "alert_fired": ("rule", "severity", "value"),
+    "alert_resolved": ("rule", "severity", "seconds"),
+    "incident_captured": ("rule", "dir", "files"),
     # -- fleet/ --------------------------------------------------------------
     "fleet_router_started": ("address", "replicas"),
     "fleet_replica_registered": ("replica", "url"),

@@ -463,6 +463,17 @@ class FleetScraper:
                 "fleet_scrape_transition", replica=replica_id, stale=stale,
             )
 
+    def forget(self, replica_id: str) -> None:
+        """Retire a departed replica's scrape state AND its
+        ``fleet_scrape_stale`` series. Wired to the registry's retire
+        listeners: a deregistered (or replaced) replica must vanish
+        from the exposition, not linger at its last value — a frozen
+        stale=1 would page forever, a frozen stale=0 would mask that
+        the replica is gone."""
+        with self._lock:
+            self._stale.pop(replica_id, None)
+        FLEET_SCRAPE_STALE.remove(replica=replica_id)
+
     def scrape(self) -> tuple[dict[str, dict], dict]:
         """One scrape pass over the in-rotation membership: returns
         ``(parsed_pages, summary)``; every replica lands in exactly one

@@ -120,9 +120,12 @@ class ClockSync:
     def forget(self, replica_id: str) -> None:
         """Drop a replica's estimate (it deregistered or was replaced —
         a successor process has a fresh clock epoch and must not inherit
-        the old one's offset)."""
+        the old one's offset). The gauge series retires with it: a
+        departed replica's last offset frozen on the exposition forever
+        reads as a live fact."""
         with self._lock:
             self._state.pop(replica_id, None)
+        FLEET_CLOCK_OFFSET.remove(replica=replica_id)
 
     def offset_s(self, replica_id: str) -> float | None:
         with self._lock:

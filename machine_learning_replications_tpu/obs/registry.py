@@ -224,6 +224,23 @@ class Family:
             raise ValueError(f"{self.name} is labeled; use .labels(...)")
         return self.labels()
 
+    def remove(self, **kv: str) -> bool:
+        """Retire one label combination: the series disappears from the
+        exposition instead of lingering forever at its last value (a
+        deregistered replica's ``fleet_scrape_stale`` must not read as a
+        stuck fact). Returns whether the child existed. A later
+        ``labels(...)`` with the same combination starts a fresh child —
+        counters restart at zero, which scrape differs must treat as a
+        reset, exactly as they must across a process restart."""
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {sorted(self.label_names)}, "
+                f"got {sorted(kv)}"
+            )
+        key = tuple(str(kv[label_name]) for label_name in self.label_names)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
     def collect(self) -> list[tuple[tuple[str, ...], object]]:
         with self._lock:
             return sorted(self._children.items())

@@ -130,7 +130,10 @@ from machine_learning_replications_tpu.obs import (
     profiler,
     reqtrace,
     slo,
+    timeseries,
 )
+from machine_learning_replications_tpu.obs import alerts as alertsmod
+from machine_learning_replications_tpu.obs import incident as incidentmod
 from machine_learning_replications_tpu.obs import quality as qualitymod
 from machine_learning_replications_tpu.obs.registry import REGISTRY
 from machine_learning_replications_tpu.resilience import faults
@@ -250,6 +253,12 @@ class ServerHandle:
         # through (make_server) — deploys update it so a post-deploy
         # restart rebuilds the CURRENT model, not the boot-time one.
         self.live = live if live is not None else {"params": None}
+        # The alerting plane (obs.timeseries / obs.alerts /
+        # obs.incident), wired by make_server; all optional.
+        self.history = None
+        self.sampler = None
+        self.alerts = None
+        self.incidents = None
         self._say = say
         self._deploy_lock = threading.Lock()
         self.deploy_status: dict | None = None
@@ -279,6 +288,8 @@ class ServerHandle:
         written through the live event loop), then stop and flush the
         transport. Safe to call more than once."""
         self.draining = True
+        if self.sampler is not None:
+            self.sampler.close()
         self.batcher.close(drain=drain)
         if self.host is not None:
             # In-flight host-path work finishes (its computes are
@@ -297,6 +308,8 @@ class ServerHandle:
             # Drain-then-stop: rows already handed off still reach the
             # monitor so a post-shutdown snapshot reflects all traffic.
             self.quality_feed.close()
+        if self.incidents is not None:
+            self.incidents.close()
 
     # -- fleet identity ------------------------------------------------------
 
@@ -919,6 +932,12 @@ class _App:
                     if handle.quality is not None
                     else {"status": "disabled"}
                 ),
+                # Alerting plane summary (obs.alerts): rule counts and
+                # the worst firing severity; None when disabled.
+                "alerts": (
+                    handle.alerts.summary()
+                    if handle.alerts is not None else None
+                ),
             })
         elif path == "/readyz":
             blockers = self._readiness_blockers()
@@ -1006,6 +1025,42 @@ class _App:
                 ),
                 "requests": self.recorder.snapshot(n),
             })
+        elif path == "/debug/alerts":
+            # In-memory read — inline is fine.
+            if handle.alerts is None:
+                rsp.send_json(200, {
+                    "enabled": False, "active": [], "summary": None,
+                })
+                return
+            snap = handle.alerts.snapshot()
+            rsp.send_json(200, {
+                "enabled": True,
+                "active": snap["active"],
+                "summary": handle.alerts.summary(),
+                "rules": snap["rules"],
+            })
+        elif path == "/debug/history":
+            store = handle.history
+            if store is None:
+                rsp.send_json(200, {"enabled": False, "families": {}})
+                return
+            family = req.query_param("family", "")
+            if not family:
+                rsp.send_json(200, {
+                    "enabled": True,
+                    "families": store.families(),
+                    "stats": store.stats(),
+                })
+                return
+            try:
+                window = float(req.query_param("window", "0") or 0)
+            except ValueError:
+                rsp.send_json(400, {"error": "window must be a number"})
+                return
+            now = time.time()  # graftcheck: disable=monotonic-clock
+            rsp.send_json(200, store.query(
+                family, window if window > 0 else None, now,
+            ))
         elif path == "/debug/profile":
             try:
                 seconds = float(req.query_param("seconds", "1"))
@@ -1316,6 +1371,12 @@ def make_server(
     admin_endpoint: bool = False,
     aot_bundle=None,
     use_aot: bool = True,
+    history_interval_s: float = 10.0,
+    alert_rules: list | None = None,
+    alerts_enabled: bool = True,
+    incident_dir: str | None = None,
+    incident_min_interval_s: float = 60.0,
+    incident_retention: int = 8,
 ) -> ServerHandle:
     """Assemble the serving stack around fitted ``params`` and bind the
     listener (not yet serving — call ``serve_forever`` or
@@ -1338,6 +1399,14 @@ def make_server(
     deadline is at or under ``tight_deadline_s`` prefer the host path.
     The split is exported as ``serve_path_total{path=…}``, echoed
     per-reply as ``X-Serve-Path``, and annotated on every trace.
+
+    ``history_interval_s`` > 0 starts the telemetry history sampler
+    (``obs.timeseries``) behind ``GET /debug/history``;
+    ``alerts_enabled`` evaluates ``alert_rules`` (None →
+    ``obs.alerts.default_rules("replica")``) each tick, served on
+    ``GET /debug/alerts`` and summarized on ``/healthz``;
+    ``incident_dir`` captures a flight-recorder bundle when a rule
+    fires (docs/OBSERVABILITY.md "Alerting & incidents").
 
     ``quality_async`` (default) feeds the drift monitor through
     ``obs.quality.AsyncQualityFeed`` — a bounded hand-off serviced by a
@@ -1568,6 +1637,35 @@ def make_server(
         admin_enabled=admin_endpoint, live={"params": params}, say=say,
         use_aot=use_aot,
     )
+    if history_interval_s > 0:
+        handle.history = timeseries.TimeSeriesStore(
+            interval_s=history_interval_s,
+        )
+        if alerts_enabled:
+            handle.alerts = alertsmod.AlertEngine(
+                alert_rules if alert_rules is not None
+                else alertsmod.default_rules("replica"),
+                handle.history,
+            )
+        if incident_dir is not None and handle.alerts is not None:
+            handle.incidents = incidentmod.IncidentCapturer(
+                incident_dir,
+                store=handle.history,
+                collectors={
+                    "requests": lambda: recorder.snapshot(64),
+                    "metrics": REGISTRY.snapshot,
+                    "slo": (
+                        slo_tracker.snapshot if slo_tracker is not None
+                        else dict
+                    ),
+                    "quality": (
+                        quality_monitor.health
+                        if quality_monitor is not None else dict
+                    ),
+                },
+                min_interval_s=incident_min_interval_s,
+                retention=incident_retention,
+            )
     app = _App(handle, request_timeout_s, quiet)
     try:
         handle.httpd = EventLoopHttpServer(
@@ -1598,4 +1696,20 @@ def make_server(
             # a caller that catches and retries doesn't hit EADDRINUSE.
             handle.httpd.server_close()
         raise
+    if handle.history is not None:
+        # Started only after the stack assembled: a bind/warmup failure
+        # must not leak a sampler thread.
+        engine_ref, capturer = handle.alerts, handle.incidents
+
+        def _tick(now: float) -> None:
+            if engine_ref is None:
+                return
+            for transition in engine_ref.evaluate(now):
+                if capturer is not None:
+                    capturer.maybe_capture(transition)
+
+        handle.sampler = timeseries.HistorySampler(
+            handle.history, timeseries.collect_registry,
+            interval_s=history_interval_s, on_tick=_tick,
+        ).start()
     return handle

@@ -1,0 +1,808 @@
+"""Alerting + incident flight recorder (obs.timeseries / obs.alerts /
+obs.incident + router wiring): tiered downsampling goldens, derived
+rate/quantile math against hand-computed values, every rule type's
+fire/resolve state machine with hold-down and hysteresis on both sides,
+burn-rate analytics, incident-bundle schema/rate-limit/atomicity, the
+router's /fleet/alerts + /debug/history endpoints over a live router,
+a steady-state no-false-positive soak, and the stale-series retirement
+regression (a deregistered replica's per-replica gauges must leave the
+exposition).
+
+Store and engine tests inject synthetic `now` values — the whole plane
+is pure of clocks by construction, which is what makes hold-down
+windows testable in microseconds.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from machine_learning_replications_tpu.fleet import make_router
+from machine_learning_replications_tpu.fleet.registry import ReplicaRegistry
+from machine_learning_replications_tpu.obs import alerts, incident, journal
+from machine_learning_replications_tpu.obs import fleetmetrics, fleettrace
+from machine_learning_replications_tpu.obs import timeseries
+from machine_learning_replications_tpu.obs.registry import (
+    REGISTRY,
+    MetricsRegistry,
+)
+from machine_learning_replications_tpu.serve.transport import (
+    EventLoopHttpServer,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from validate_metrics import diff_counters, validate  # noqa: E402
+import loadgen  # noqa: E402
+
+
+@pytest.fixture
+def jrn(tmp_path):
+    j = journal.RunJournal(tmp_path / "journal.jsonl", command="test")
+    journal.set_journal(j)
+    yield j
+    journal.set_journal(None)
+    j.close()
+
+
+def _events(j, kind=None):
+    with open(j.path) as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    evs = [e for e in evs if e.get("kind") != "manifest"]
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs
+
+
+# ---------------------------------------------------------------------------
+# collect_registry: the local sampling pass
+# ---------------------------------------------------------------------------
+
+
+def test_collect_registry_normalized_shape():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "c", labels=("k",))
+    c.inc(k="a")
+    c.inc(k="a")
+    g = reg.gauge("g", "g")
+    g.set(3.5)
+    h = reg.histogram("h_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+
+    fams = timeseries.collect_registry(reg)
+    assert fams["c_total"]["kind"] == "counter"
+    assert fams["c_total"]["series"][(("k", "a"),)] == 2.0
+    assert fams["g"]["series"][()] == 3.5
+    snap = fams["h_seconds"]["series"][()]
+    assert snap["count"] == 2 and snap["buckets"]["+Inf"] == 2
+
+
+# ---------------------------------------------------------------------------
+# store: raw ring, windows, tiered downsampling
+# ---------------------------------------------------------------------------
+
+
+def _gauge_fam(v):
+    return {"g": {"kind": "gauge", "series": {(): float(v)}}}
+
+
+def _counter_fam(v):
+    return {"c_total": {"kind": "counter", "series": {(): float(v)}}}
+
+
+def test_store_window_latest_and_families():
+    st = timeseries.TimeSeriesStore(interval_s=1.0, raw_retention_s=100.0)
+    for t in range(5):
+        st.ingest(_gauge_fam(t * 10), now=float(t))
+    assert st.families() == {"g": 1}
+    [(lab, t, v)] = st.latest("g")
+    assert lab == {} and t == 4.0 and v == 40.0
+    [(_, pts)] = st.window("g", 2.5, now=4.0)
+    assert [v for _t, v in pts] == [20.0, 30.0, 40.0]
+    assert st.last_sample_age_s("g", now=6.0) == 2.0
+    assert st.last_sample_age_s("nope", now=6.0) is None
+
+
+def test_downsampling_golden_gauge_avg_counter_last():
+    """Raw ring of 10 samples; older samples survive only in the agg
+    tier — whose points carry the bucket AVERAGE for gauges and the
+    bucket-edge LAST value for counters."""
+    st = timeseries.TimeSeriesStore(
+        interval_s=1.0, raw_retention_s=10.0, agg_bucket_s=5.0,
+        agg_retention_s=100.0,
+    )
+    for t in range(30):
+        st.ingest({**_gauge_fam(t), **_counter_fam(2 * t)}, now=float(t))
+    # Raw ring capacity 12: raw starts at t=18. Buckets [0..4], [5..9],
+    # [10..14] are flushed; gauge avg of [0..4] is 2, counter last is 8.
+    [(_, gpts)] = st.window("g", 30.0, now=29.0)
+    agg_g = [p for p in gpts if p[0] < 18.0]
+    assert agg_g[0] == (0.0, 2.0)
+    assert agg_g[1] == (5.0, 7.0)
+    [(_, cpts)] = st.window("c_total", 30.0, now=29.0)
+    agg_c = [p for p in cpts if p[0] < 18.0]
+    assert agg_c[0] == (0.0, 8.0)       # last of bucket [0..4]: 2*4
+    assert agg_c[1] == (5.0, 18.0)      # last of bucket [5..9]: 2*9
+    # And the raw tail is the verbatim samples.
+    assert (29.0, 29.0) == gpts[-1] and (29.0, 58.0) == cpts[-1]
+
+
+def test_rate_is_reset_safe_and_delta_signed():
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    for t, v in enumerate([10.0, 12.0, 14.0, 1.0, 3.0]):
+        st.ingest(_counter_fam(v), now=float(t))
+    # Positive increments only: 2+2+0(reset)+2 = 6 over 4 s.
+    [(_, r)] = st.rate("c_total", 10.0, now=4.0)
+    assert r == pytest.approx(6.0 / 4.0)
+    # delta() is newest-oldest, signed — the rate-of-change primitive.
+    [(_, d)] = st.delta("c_total", 10.0, now=4.0)
+    assert d == pytest.approx(3.0 - 10.0)
+
+
+def test_nan_gauge_sample_is_skipped():
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    st.ingest(_gauge_fam(1.0), now=0.0)
+    st.ingest(_gauge_fam(float("nan")), now=1.0)
+    st.ingest(_gauge_fam(3.0), now=2.0)
+    [(_, a)] = st.avg("g", 10.0, now=2.0)
+    assert a == pytest.approx(2.0)
+
+
+def _hist_fam(buckets, total, s):
+    return {"h": {"kind": "histogram", "series": {(): {
+        "buckets": dict(buckets), "sum": s, "count": total,
+    }}}}
+
+
+def test_quantile_golden_vs_hand_computed():
+    """Prometheus-style interpolation over the windowed bucket delta:
+    {le 0.1: 5, le 1.0: 10, +Inf: 10} → q50 = 0.1 (bucket edge), q75 =
+    0.1 + (1.0-0.1) * (7.5-5)/5 = 0.55."""
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    st.ingest(_hist_fam({"0.1": 0, "1.0": 0, "+Inf": 0}, 0, 0.0), now=0.0)
+    st.ingest(
+        _hist_fam({"0.1": 5, "1.0": 10, "+Inf": 10}, 10, 3.0), now=10.0
+    )
+    [(_, q50)] = st.quantile("h", 0.5, 20.0, now=10.0)
+    [(_, q75)] = st.quantile("h", 0.75, 20.0, now=10.0)
+    assert q50 == pytest.approx(0.1)
+    assert q75 == pytest.approx(0.55)
+
+
+def test_quantile_windowed_delta_subtracts_baseline():
+    """Observations BEFORE the window must not count: the baseline
+    snapshot at the window edge is subtracted."""
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    # 10 fast observations land before the window...
+    st.ingest(
+        _hist_fam({"0.1": 10, "1.0": 10, "+Inf": 10}, 10, 0.5), now=0.0
+    )
+    # ...then 4 slow ones inside it.
+    st.ingest(
+        _hist_fam({"0.1": 10, "1.0": 14, "+Inf": 14}, 14, 3.0), now=10.0
+    )
+    [(_, q50)] = st.quantile("h", 0.5, 5.0, now=10.0)
+    # All 4 windowed observations sit in (0.1, 1.0]: q50 interpolates
+    # inside that bucket, far above the lifetime-median 0.1.
+    assert q50 == pytest.approx(0.1 + 0.9 * 0.5)
+    # +Inf-only mass reports the last finite bound.
+    st.ingest(
+        _hist_fam({"0.1": 10, "1.0": 14, "+Inf": 16}, 16, 9.0), now=11.0
+    )
+    [(_, q99)] = st.quantile("h", 0.6, 0.5, now=11.0)
+    assert q99 == pytest.approx(1.0)
+
+
+def test_query_serialization_and_dump():
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    st.ingest({**_gauge_fam(1.0),
+               **_hist_fam({"+Inf": 3}, 3, 0.3)}, now=1.0)
+    q = st.query("g", None, now=1.0)
+    assert q["series"][0]["points"] == [[1.0, 1.0]]
+    qh = st.query("h", None, now=1.0)
+    assert qh["series"][0]["points"] == [[1.0, 3.0, 0.3]]  # [t, count, sum]
+    d = st.dump(60.0, now=1.0)
+    assert set(d) == {"g", "h"}
+
+
+def test_history_sampler_thread_swallows_collect_errors():
+    st = timeseries.TimeSeriesStore(interval_s=0.02)
+    calls = {"n": 0, "ticks": 0}
+
+    def collect():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("scrape hiccup")
+        return _gauge_fam(calls["n"])
+
+    s = timeseries.HistorySampler(
+        st, collect, on_tick=lambda now: calls.__setitem__(
+            "ticks", calls["ticks"] + 1
+        ),
+    ).start()
+    deadline = time.monotonic() + 5
+    while calls["n"] < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    s.close()
+    assert calls["n"] >= 3 and calls["ticks"] >= 3
+    assert st.stats()["ticks"] >= 2  # the bad tick ingested nothing
+
+
+# ---------------------------------------------------------------------------
+# rules: each type, both directions, hold-down + hysteresis
+# ---------------------------------------------------------------------------
+
+
+def _engine(rule_spec, st):
+    return alerts.AlertEngine([alerts.build_rule(rule_spec)], st)
+
+
+def test_threshold_rule_holddown_and_resolve_hysteresis(jrn):
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    eng = _engine({
+        "type": "threshold", "name": "hot", "severity": "warn",
+        "family": "g", "op": ">=", "threshold": 10.0,
+        "for_s": 2.0, "resolve_for_s": 2.0,
+    }, st)
+
+    def step(t, v):
+        st.ingest(_gauge_fam(v), now=float(t))
+        return eng.evaluate(float(t))
+
+    assert step(0, 50) == []                     # pending
+    assert step(1, 50) == []                     # held down
+    [tr] = step(2, 50)                           # fired after for_s
+    assert tr["transition"] == "fired" and tr["rule"] == "hot"
+    assert alerts.ALERTS_ACTIVE.labels(rule="hot", severity="warn").value \
+        == 1.0
+    assert step(3, 1) == []                      # resolving, held
+    [tr] = step(5, 1)                            # resolved after hold
+    assert tr["transition"] == "resolved"
+    assert tr["fired_for_s"] == pytest.approx(3.0)
+    assert alerts.ALERTS_ACTIVE.labels(rule="hot", severity="warn").value \
+        == 0.0
+    fired = _events(jrn, "alert_fired")
+    resolved = _events(jrn, "alert_resolved")
+    assert len(fired) == 1 and fired[0]["rule"] == "hot"
+    assert len(resolved) == 1 and \
+        resolved[0]["seconds"] == pytest.approx(3.0)
+
+
+def test_threshold_blip_never_fires(jrn):
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    eng = _engine({
+        "type": "threshold", "name": "hot", "family": "g",
+        "threshold": 10.0, "for_s": 2.0,
+    }, st)
+    st.ingest(_gauge_fam(50), now=0.0)
+    assert eng.evaluate(0.0) == []
+    st.ingest(_gauge_fam(1), now=1.0)            # breach clears early
+    assert eng.evaluate(1.0) == []
+    st.ingest(_gauge_fam(50), now=2.0)           # hold-down restarts
+    assert eng.evaluate(2.0) == []
+    assert _events(jrn, "alert_fired") == []
+    assert eng.summary()["firing"] == 0
+
+
+def test_rebreach_during_hysteresis_is_same_incident(jrn):
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    eng = _engine({
+        "type": "threshold", "name": "hot", "family": "g",
+        "threshold": 10.0, "for_s": 0.0, "resolve_for_s": 5.0,
+    }, st)
+    st.ingest(_gauge_fam(50), now=0.0)
+    assert len(eng.evaluate(0.0)) == 1           # fires immediately
+    st.ingest(_gauge_fam(1), now=1.0)
+    assert eng.evaluate(1.0) == []               # resolving
+    st.ingest(_gauge_fam(50), now=2.0)
+    assert eng.evaluate(2.0) == []               # back to firing, silent
+    assert len(_events(jrn, "alert_fired")) == 1
+    [active] = eng.active()
+    assert active["state"] == "firing" and active["since"] == 0.0
+
+
+def test_threshold_less_than_with_window_avg():
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    eng = _engine({
+        "type": "threshold", "name": "low", "family": "g",
+        "op": "<", "threshold": 5.0, "window_s": 10.0, "for_s": 0.0,
+        "resolve_for_s": 0.0,
+    }, st)
+    st.ingest(_gauge_fam(9.0), now=0.0)
+    st.ingest(_gauge_fam(7.0), now=1.0)          # avg 8 → no breach
+    assert eng.evaluate(1.0) == []
+    st.ingest(_gauge_fam(0.0), now=2.0)
+    st.ingest(_gauge_fam(0.0), now=3.0)          # avg 4 → breach
+    [tr] = eng.evaluate(3.0)
+    assert tr["transition"] == "fired" and tr["value"] == 4.0
+
+
+def test_burn_rate_needs_both_windows(jrn):
+    """Google-SRE multi-window: the FAST window alone (a blip) must not
+    fire; fast AND slow over the factor fires; recovery resolves."""
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    eng = _engine({
+        "type": "burn_rate", "name": "burn", "severity": "page",
+        "family": "b", "factor": 14.4, "fast_s": 10.0, "slow_s": 100.0,
+        "for_s": 0.0, "resolve_for_s": 0.0,
+    }, st)
+
+    def feed(t, v):
+        st.ingest({"b": {"kind": "gauge", "series": {(): float(v)}}},
+                  now=float(t))
+
+    # 90 s of calm, then a 10 s spike: fast avg = 20 >= 14.4 but slow
+    # avg = (90*1 + 10*20) / 100 = 2.9 — NOT an emergency yet.
+    for t in range(90):
+        feed(t, 1.0)
+    for t in range(90, 100):
+        feed(t, 20.0)
+    assert eng.evaluate(99.0) == []
+    # Sustained burn: every sample in both windows now reads 20.
+    for t in range(100, 200):
+        feed(t, 20.0)
+    [tr] = eng.evaluate(199.0)
+    assert tr["transition"] == "fired"
+    # Analytic check: both window averages are exactly 20.
+    [(_, fast)] = st.avg("b", 10.0, now=199.0)
+    [(_, slow)] = st.avg("b", 100.0, now=199.0)
+    assert fast == pytest.approx(20.0) and slow == pytest.approx(20.0)
+    # Recovery.
+    for t in range(200, 320):
+        feed(t, 0.0)
+    [tr] = eng.evaluate(319.0)
+    assert tr["transition"] == "resolved"
+
+
+def test_absence_rule_staleness_and_warmup_grace():
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    eng = _engine({
+        "type": "absence", "name": "gone", "family": "g",
+        "stale_after_s": 5.0, "for_s": 0.0, "resolve_for_s": 0.0,
+    }, st)
+    # Never sampled: grace until the engine is stale_after_s old.
+    assert eng.evaluate(0.0) == []
+    assert eng.evaluate(4.0) == []
+    [tr] = eng.evaluate(6.0)                     # still absent → fired
+    assert tr["transition"] == "fired"
+    st.ingest(_gauge_fam(1.0), now=7.0)          # samples resume
+    [tr] = eng.evaluate(7.5)
+    assert tr["transition"] == "resolved"
+    # Goes stale again after samples stop.
+    assert eng.evaluate(11.0) == []              # age 4 < 5
+    [tr] = eng.evaluate(13.0)                    # age 6 → fired
+    assert tr["transition"] == "fired"
+
+
+def test_rate_of_change_rule_absolute_delta():
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    eng = _engine({
+        "type": "rate_of_change", "name": "drift", "family": "psi",
+        "max_delta": 0.2, "window_s": 10.0, "for_s": 0.0,
+        "resolve_for_s": 0.0,
+    }, st)
+
+    def feed(t, v):
+        st.ingest({"psi": {"kind": "gauge", "series": {(): v}}},
+                  now=float(t))
+
+    feed(0, 0.05)
+    feed(1, 0.08)
+    assert eng.evaluate(1.0) == []               # |Δ| = 0.03
+    feed(2, 0.40)                                # |Δ| = 0.35 → breach
+    [tr] = eng.evaluate(2.0)
+    assert tr["transition"] == "fired"
+    # A downward move of the same magnitude breaches too (abs).
+    for t in range(3, 20):
+        feed(t, 0.40)
+    [tr] = eng.evaluate(19.0)
+    assert tr["transition"] == "resolved"
+    feed(20, 0.10)
+    [tr] = eng.evaluate(20.0)
+    assert tr["transition"] == "fired"
+
+
+def test_rule_check_error_is_contained_per_rule(jrn):
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+
+    class _Broken(alerts.ThresholdRule):
+        def check(self, store, now):
+            raise RuntimeError("boom")
+
+    broken = _Broken({"type": "threshold", "name": "bad", "family": "g",
+                      "threshold": 1.0})
+    ok = alerts.build_rule({
+        "type": "threshold", "name": "good", "family": "g",
+        "threshold": 1.0, "for_s": 0.0,
+    })
+    eng = alerts.AlertEngine([broken, ok], st)
+    st.ingest(_gauge_fam(5.0), now=0.0)
+    [tr] = eng.evaluate(0.0)                     # good still fires
+    assert tr["rule"] == "good"
+    snap = {r["name"]: r for r in eng.snapshot()["rules"]}
+    assert snap["bad"]["detail"].startswith("check error:")
+    assert snap["bad"]["state"] == "inactive"
+
+
+def test_rule_spec_validation_and_load_rules(tmp_path):
+    with pytest.raises(ValueError, match="unknown rule type"):
+        alerts.build_rule({"type": "nope", "name": "x", "family": "g"})
+    with pytest.raises(ValueError, match="severity"):
+        alerts.build_rule({"type": "threshold", "name": "x",
+                           "family": "g", "threshold": 1,
+                           "severity": "catastrophic"})
+    with pytest.raises(ValueError, match="op"):
+        alerts.build_rule({"type": "threshold", "name": "x",
+                           "family": "g", "threshold": 1, "op": "~"})
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([
+        {"type": "threshold", "name": "a", "family": "g", "threshold": 1},
+        {"type": "threshold", "name": "a", "family": "g", "threshold": 2},
+    ]))
+    with pytest.raises(ValueError, match="duplicate"):
+        alerts.load_rules(str(p))
+    p.write_text(json.dumps({"not": "a list"}))
+    with pytest.raises(ValueError, match="JSON list"):
+        alerts.load_rules(str(p))
+    p.write_text(json.dumps([
+        {"type": "burn_rate", "name": "b", "family": "g"},
+        {"type": "absence", "name": "c", "family": "g"},
+    ]))
+    loaded = alerts.load_rules(str(p))
+    assert [r.name for r in loaded] == ["b", "c"]
+    for role in ("router", "replica"):
+        assert alerts.default_rules(role)
+    with pytest.raises(ValueError):
+        alerts.default_rules("toaster")
+
+
+# ---------------------------------------------------------------------------
+# incident capturer: schema, admission control, atomicity, retention
+# ---------------------------------------------------------------------------
+
+
+def _transition(at=1000.0, rule="hot"):
+    return {"transition": "fired", "rule": rule, "severity": "page",
+            "at": at, "value": 9.0, "detail": "g = 9",
+            "spec": {"name": rule}}
+
+
+def test_bundle_schema_manifest_last(tmp_path, jrn):
+    st = timeseries.TimeSeriesStore(interval_s=1.0)
+    st.ingest(_gauge_fam(9.0), now=999.0)
+    cap = incident.IncidentCapturer(
+        tmp_path / "inc", store=st,
+        collectors={"extra": lambda: {"k": 1}},
+    )
+    bundle = cap.capture(_transition())
+    assert bundle is not None
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["schema"] == incident.SCHEMA_VERSION
+    assert manifest["rule"] == "hot" and manifest["errors"] == {}
+    assert sorted(manifest["files"]) == [
+        "alert.json", "extra.json", "history.json", "journal_tail.jsonl",
+    ]
+    for name in manifest["files"]:
+        assert os.path.exists(os.path.join(bundle, name))
+    with open(os.path.join(bundle, "alert.json")) as f:
+        assert json.load(f)["rule"] == "hot"
+    assert cap.bundles() == [bundle]
+    [ev] = _events(jrn, "incident_captured")
+    assert ev["rule"] == "hot" and ev["files"] == 4
+    # A failing collector is recorded, not fatal.
+    cap2 = incident.IncidentCapturer(
+        tmp_path / "inc2",
+        collectors={"bad": lambda: 1 / 0},
+    )
+    b2 = cap2.capture(_transition(at=2000.0))
+    with open(os.path.join(b2, "manifest.json")) as f:
+        m2 = json.load(f)
+    assert "bad.json" in m2["errors"]
+
+
+def test_capture_rate_limit_and_single_flight(tmp_path):
+    cap = incident.IncidentCapturer(tmp_path / "inc", min_interval_s=3600)
+    assert cap.maybe_capture({"transition": "resolved"}) is None
+    assert cap.maybe_capture(_transition()) == "captured"
+    cap.close()
+    assert cap.maybe_capture(_transition(at=2000.0)) == "rate_limited"
+    # Single-flight: while a capture is in flight, new firings drop.
+    cap2 = incident.IncidentCapturer(tmp_path / "inc2", min_interval_s=0)
+    with cap2._lock:
+        cap2._in_flight = True
+    assert cap2.maybe_capture(_transition()) == "in_flight"
+
+
+def test_crashed_capture_leaves_no_manifest_and_is_swept(
+    tmp_path, monkeypatch,
+):
+    cap = incident.IncidentCapturer(tmp_path / "inc", min_interval_s=0)
+    monkeypatch.setattr(
+        incident, "atomic_json_write",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+    )
+    assert cap.capture(_transition()) is None
+    # The torn directory exists but has no manifest: readers skip it.
+    leftovers = os.listdir(tmp_path / "inc")
+    assert leftovers and cap.bundles() == []
+    monkeypatch.undo()
+    # The next successful capture's retention sweep removes the wreck.
+    bundle = cap.capture(_transition(at=2000.0))
+    assert cap.bundles() == [bundle]
+    assert os.listdir(tmp_path / "inc") == [os.path.basename(bundle)]
+
+
+def test_bundle_retention_keeps_newest(tmp_path):
+    cap = incident.IncidentCapturer(
+        tmp_path / "inc", min_interval_s=0, retention=2,
+    )
+    dirs = [
+        cap.capture(_transition(at=1000.0 + 60 * i, rule=f"r{i}"))
+        for i in range(3)
+    ]
+    kept = cap.bundles()
+    assert kept == dirs[1:]
+
+
+# ---------------------------------------------------------------------------
+# stale-series hygiene: retirement on deregister/replace
+# ---------------------------------------------------------------------------
+
+
+def test_family_remove_retires_series():
+    reg = MetricsRegistry()
+    g = reg.gauge("per_replica", "g", labels=("replica",))
+    g.set(1.0, replica="a")
+    g.set(2.0, replica="b")
+    assert g.remove(replica="a") is True
+    assert g.remove(replica="a") is False        # already gone
+    with pytest.raises(ValueError):
+        g.remove(nope="a")
+    text = reg.render_prometheus()
+    assert 'replica="a"' not in text and 'replica="b"' in text
+
+
+def test_scraper_and_clocksync_forget_retire_gauges():
+    registry = ReplicaRegistry()
+    registry.register("ghost-xyz", "http://127.0.0.1:9")  # unreachable
+    scraper = fleetmetrics.FleetScraper(registry, timeout_s=0.05)
+    registry._replicas["ghost-xyz"].state = "ready"
+    scraper.scrape()
+    page = REGISTRY.render_prometheus()
+    assert 'fleet_scrape_stale{replica="ghost-xyz"} 1' in page
+    scraper.forget("ghost-xyz")
+    assert 'replica="ghost-xyz"' not in REGISTRY.render_prometheus()
+
+    cs = fleettrace.ClockSync()
+    cs.observe("ghost-xyz", t_send=0.0, t_recv=0.01, replica_clock=5.0)
+    assert 'fleet_clock_offset_ms{replica="ghost-xyz"}' in \
+        REGISTRY.render_prometheus()
+    cs.forget("ghost-xyz")
+    assert 'replica="ghost-xyz"' not in REGISTRY.render_prometheus()
+
+
+def test_registry_retire_listeners_fire_on_deregister_and_replace():
+    registry = ReplicaRegistry()
+    retired = []
+    registry.add_retire_listener(retired.append)
+    registry.register("p1", "http://127.0.0.1:1111")
+    registry.register("p1", "http://127.0.0.1:1111")  # idempotent beat
+    assert retired == []
+    registry.register("p1", "http://127.0.0.1:2222")  # replacement
+    assert retired == ["p1"]
+    registry.deregister("p1")
+    assert retired == ["p1", "p1"]
+    registry.deregister("p1")                         # absent: no event
+    assert retired == ["p1", "p1"]
+    # A throwing listener must not break registration.
+    registry.add_retire_listener(
+        lambda rid: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    registry.register("p2", "http://127.0.0.1:3333")
+    registry.deregister("p2")
+    assert retired[-1] == "p2"
+
+
+# ---------------------------------------------------------------------------
+# live router: /fleet/alerts, /debug/history, healthz, soak, retirement
+# ---------------------------------------------------------------------------
+
+
+PAGE = """\
+# HELP stub_requests_total requests
+# TYPE stub_requests_total counter
+stub_requests_total{outcome="ok"} 10
+"""
+
+
+class _StubReplica:
+    def __init__(self, rid):
+        self.rid = rid
+
+    def handle_request(self, req, rsp):
+        if req.path == "/readyz":
+            rsp.send_json(200, {
+                "ready": True, "reasons": [], "replica": self.rid,
+                "version": 1, "queue_depth": 0,
+                "clock_perf": time.perf_counter(),
+            })
+        elif req.path == "/metrics":
+            rsp.send(200, PAGE.encode(), "text/plain; version=0.0.4")
+        else:
+            rsp.send_json(404, {"error": "nope"})
+
+    def handle_protocol_error(self, exc, rsp):
+        rsp.send_json(exc.code, {"error": exc.message}, close=True)
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_router_alerts_and_history_endpoints(tmp_path):
+    stubs, httpds, members = [], [], []
+    for i in range(2):
+        stub = _StubReplica(f"alrt{i + 1}")
+        httpd = EventLoopHttpServer(("127.0.0.1", 0), stub)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        stubs.append(stub)
+        httpds.append(httpd)
+        members.append(
+            (stub.rid, f"http://127.0.0.1:{httpd.server_address[1]}")
+        )
+    router = make_router(
+        port=0, replicas=members, probe_interval_s=0.1,
+        request_timeout_s=5.0, history_interval_s=0.1,
+        incident_dir=str(tmp_path / "inc"),
+    ).start_background()
+    try:
+        deadline = time.monotonic() + 10
+        while router.registry.ready_count() < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert router.registry.ready_count() == 2
+        base = f"http://{router.address[0]}:{router.address[1]}"
+
+        # Let the sampler take a handful of ticks (the soak window).
+        deadline = time.monotonic() + 10
+        while router.history.stats()["ticks"] < 5 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+
+        # -- /debug/history ----------------------------------------------
+        status, body = _get_json(base + "/debug/history")
+        assert status == 200 and body["enabled"]
+        assert "fleet_replicas" in body["families"]
+        # The merged fleet page rides the same store: replica families
+        # appear under their appended replica label.
+        assert "stub_requests_total" in body["families"]
+        status, body = _get_json(
+            base + "/debug/history?family=fleet_replicas&window=60"
+        )
+        assert status == 200
+        states = {s["labels"]["state"]: s["points"]
+                  for s in body["series"]}
+        assert states["ready"][-1][1] == 2.0
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get_json(base + "/debug/history?family=x&window=banana")
+        assert exc_info.value.code == 400
+
+        # -- /fleet/alerts + healthz: the steady-state soak ---------------
+        status, body = _get_json(base + "/fleet/alerts")
+        assert status == 200 and body["enabled"]
+        assert body["active"] == [], (
+            "false positives in a healthy steady state", body["active"],
+        )
+        assert body["summary"]["firing"] == 0
+        assert {r["name"] for r in body["rules"]} == {
+            r.name for r in alerts.default_rules("router")
+        }
+        assert all(r["state"] == "inactive" for r in body["rules"])
+        status, hz = _get_json(base + "/healthz")
+        assert hz["alerts"]["firing"] == 0
+        assert hz["alerts"]["rules"] == len(body["rules"])
+
+        # And the alert/history families ride the router's exposition.
+        with urllib.request.urlopen(
+            base + "/metrics", timeout=10.0
+        ) as resp:
+            page = resp.read().decode()
+        assert validate(page) == []
+        for fam in ("alerts_active", "alerts_transitions_total",
+                    "history_samples_total", "history_series",
+                    "incident_captures_total"):
+            assert fam in page, fam
+
+        # -- stale-series retirement over the live wire -------------------
+        # The scraper has populated per-replica gauges for both stubs;
+        # deregistering one must retire its series from the exposition.
+        assert 'fleet_scrape_stale{replica="alrt2"} 0' in page
+        router.registry.deregister("alrt2")
+        page = REGISTRY.render_prometheus()
+        assert 'replica="alrt2"' not in page
+        assert 'fleet_scrape_stale{replica="alrt1"} 0' in page
+    finally:
+        router.shutdown()
+        for h in httpds:
+            h.server_close()
+        # Hygiene: retire the surviving stub's series so later tests see
+        # a clean registry.
+        router.scraper.forget("alrt1")
+        router.clock_sync.forget("alrt1")
+
+
+def test_router_history_disabled():
+    router = make_router(
+        port=0, history_interval_s=0.0, start_prober=False,
+    ).start_background()
+    try:
+        base = f"http://{router.address[0]}:{router.address[1]}"
+        status, body = _get_json(base + "/debug/history")
+        assert status == 200 and body["enabled"] is False
+        status, body = _get_json(base + "/fleet/alerts")
+        assert body == {"enabled": False, "active": [], "summary": None}
+        status, hz = _get_json(base + "/healthz")
+        assert hz["alerts"] is None
+        assert router.history is None and router.alerts is None
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite tools: validate_metrics --diff, loadgen --assert-slo
+# ---------------------------------------------------------------------------
+
+
+PAGE_A = """\
+# TYPE a_total counter
+a_total{k="x"} 10
+# TYPE g gauge
+g 100
+# TYPE h_seconds histogram
+h_seconds_bucket{le="0.1"} 5
+h_seconds_bucket{le="+Inf"} 8
+h_seconds_sum 1.5
+h_seconds_count 8
+"""
+
+
+def test_diff_counters_monotonicity():
+    page_b_ok = PAGE_A.replace("a_total{k=\"x\"} 10",
+                               "a_total{k=\"x\"} 12")
+    page_b_ok = page_b_ok.replace("g 100", "g 1")  # gauges may fall
+    assert diff_counters(PAGE_A, page_b_ok) == []
+    regressed = PAGE_A.replace("h_seconds_count 8", "h_seconds_count 7")
+    errs = diff_counters(PAGE_A, regressed)
+    assert errs and "h_seconds_count" in errs[0]
+    # A series present on only one side is legitimate (retirement).
+    gone = "\n".join(
+        line for line in PAGE_A.splitlines()
+        if not line.startswith("a_total")
+    ) + "\n"
+    assert diff_counters(PAGE_A, gone) == []
+
+
+def test_loadgen_slo_budget_parse_and_check():
+    budget = loadgen._parse_slo_budget("P50:10,p99:50,ERR:0.01")
+    assert budget == {"p50": 10.0, "p99": 50.0, "err": 0.01}
+    for bad in ("p42:1", "p50:1,p50:2", "p50:banana", "p50:-1", ""):
+        with pytest.raises(ValueError):
+            loadgen._parse_slo_budget(bad)
+    art = {"n_sent": 100, "n_ok": 99, "n_shed": 1, "n_err": 0,
+           "latency_ms": {"p50": 5.0, "p95": 20.0, "p99": 60.0,
+                          "mean": 8.0, "max": 80.0}}
+    assert loadgen._check_slo_budget(art, {"p50": 10.0}) == []
+    v = loadgen._check_slo_budget(art, {"p99": 50.0, "err": 0.005})
+    assert len(v) == 2
+    # No successful requests: any latency bound is a violation.
+    dead = {"n_sent": 10, "n_ok": 0, "n_shed": 0, "n_err": 10,
+            "latency_ms": None}
+    assert loadgen._check_slo_budget(dead, {"p50": 10.0})

@@ -156,10 +156,10 @@ def _mk_manager(monkeypatch, clk, ready, depths, launcher=None, **kw):
     return mgr, router
 
 
-def _sig(q=None, lat=None, shed=None, burn=None):
+def _sig(q=None, lat=None, shed=None, burn=None, alerts=None):
     return {
         "queue_depth": q, "latency_ms": lat, "shed_rate": shed,
-        "burn_rate": burn,
+        "burn_rate": burn, "alerts_active": alerts,
     }
 
 

@@ -409,6 +409,7 @@ def run_fleet_drill(args) -> int:
     )
     from machine_learning_replications_tpu.fleet import make_router
     from machine_learning_replications_tpu.models import stacking
+    from machine_learning_replications_tpu.obs import alerts as obs_alerts
     from machine_learning_replications_tpu.obs import journal
     from machine_learning_replications_tpu.persist import orbax_io
 
@@ -416,6 +417,31 @@ def run_fleet_drill(args) -> int:
     journal_path = args.journal or os.path.join(workdir, "router.jsonl")
     jrn = journal.RunJournal(journal_path, command="chaos_drill --fleet")
     journal.set_journal(jrn)
+
+    # Alerting arc (docs/OBSERVABILITY.md "Alerting & incidents"): the
+    # drill's rules come from a FILE — the operator wire-through path —
+    # and are chosen so the healthy baseline is silent and the
+    # kill-replica fault deterministically fires. fleet_replicas{state=
+    # "out"} is 0 at startup (new replicas are probing, never out), so
+    # cold warmup cannot false-positive; the default stale-replica rule
+    # is deliberately absent (a killed replica's stale window depends on
+    # respawn warmup time — nondeterministic in a drill).
+    rules_path = os.path.join(workdir, "alert_rules.json")
+    with open(rules_path, "w") as f:
+        json.dump([
+            {
+                "type": "threshold", "name": "fleet_capacity_degraded",
+                "severity": "page", "family": "fleet_replicas",
+                "labels": {"state": "out"}, "op": ">=", "threshold": 1.0,
+                "for_s": 0.75, "resolve_for_s": 0.75,
+            },
+            {
+                "type": "burn_rate", "name": "fleet_error_budget_burn",
+                "severity": "page", "family": "fleet_slo_burn_rate",
+                "for_s": 1.0, "resolve_for_s": 2.0,
+            },
+        ], f, indent=1)
+    incident_dir = os.path.join(workdir, "incidents")
 
     ckpt = os.path.join(workdir, "model")
     p_v1, p_v2 = make_sklearn_params(seed=7), make_sklearn_params(seed=11)
@@ -429,6 +455,10 @@ def run_fleet_drill(args) -> int:
     router = make_router(
         port=0, probe_interval_s=0.2, request_timeout_s=8.0,
         hedge_ms=300.0, max_attempts=3,
+        history_interval_s=0.25,
+        alert_rules=obs_alerts.load_rules(rules_path),
+        incident_dir=incident_dir,
+        incident_min_interval_s=0.0,
     ).start_background()
     base = f"http://{router.address[0]}:{router.address[1]}"
     ports = {"r1": _free_port(), "r2": _free_port()}
@@ -451,6 +481,30 @@ def run_fleet_drill(args) -> int:
         )
         traffic = _Traffic(base, dict(EXAMPLE_PATIENT), goldens).start()
         time.sleep(2.0)  # a baseline window of healthy two-replica traffic
+
+        # Healthy-baseline alert silence: warmup + the first traffic
+        # window must produce zero firing rules and zero journaled
+        # transitions — an alerting plane that cries during a normal
+        # cold start would be ignored by the third incident.
+        with urllib.request.urlopen(
+            base + "/fleet/alerts", timeout=HARD_TIMEOUT_S
+        ) as resp:
+            baseline_alerts = json.loads(resp.read())
+        assert baseline_alerts["enabled"], baseline_alerts
+        assert not baseline_alerts["active"], (
+            "alerts fired during the healthy baseline",
+            baseline_alerts["active"],
+        )
+        if args.metrics_early_out:
+            with urllib.request.urlopen(
+                base + "/metrics", timeout=HARD_TIMEOUT_S
+            ) as resp:
+                with open(args.metrics_early_out, "w") as f:
+                    f.write(resp.read().decode())
+            print(
+                f"baseline metrics written to {args.metrics_early_out}",
+                file=sys.stderr,
+            )
 
         # Cross-process joined timeline, captured while both replicas
         # are healthy (the kill/deploy scenarios below legitimately
@@ -493,6 +547,36 @@ def run_fleet_drill(args) -> int:
         assert set(win["outcomes"]) <= {"ok"}, (
             "kill-replica window saw client-visible failures", win,
         )
+
+        # The fault must FIRE the capacity rule (rotation-out drives
+        # fleet_replicas{state="out"} to 1, the engine holds it for_s,
+        # then journals alert_fired) …
+        wait_until(
+            lambda: any(
+                a["rule"] == "fleet_capacity_degraded"
+                and a["state"] == "firing"
+                for a in router.alerts.active()
+            ),
+            30.0, "capacity alert fired after the replica kill",
+            poll_s=0.2,
+        )
+        # … and the firing must CAPTURE a complete incident bundle
+        # (bundles() lists manifest-complete dirs only — the manifest is
+        # written last, so its presence IS the completeness marker).
+        wait_until(
+            lambda: router.incidents.bundles(), 30.0,
+            "incident bundle captured on firing", poll_s=0.2,
+        )
+        bundle_dir = router.incidents.bundles()[0]
+        with open(os.path.join(bundle_dir, "manifest.json")) as f:
+            manifest = json.loads(f.read())
+        assert manifest["rule"] == "fleet_capacity_degraded", manifest
+        for needed in ("alert.json", "history.json", "requests.json",
+                       "replicas.json", "journal_tail.jsonl"):
+            assert needed in manifest["files"], (needed, manifest)
+            assert os.path.exists(os.path.join(bundle_dir, needed)), needed
+        assert not manifest["errors"], manifest
+
         # Respawn: same id + port re-registers idempotently and probes
         # back into rotation.
         procs["r1"] = _spawn_replica(
@@ -502,6 +586,23 @@ def run_fleet_drill(args) -> int:
             lambda: router.registry.ready_count() == 2, 240.0,
             "respawned replica back in rotation", poll_s=0.5,
         )
+        # Recovery must RESOLVE the alert (out-count back to 0, held
+        # for resolve_for_s, journaled alert_resolved) — the full
+        # fault → fire → capture → recover → resolve arc.
+        wait_until(
+            lambda: not router.alerts.active(), 60.0,
+            "capacity alert resolved after the respawn", poll_s=0.2,
+        )
+        alerting = {
+            "baseline_active": 0,
+            "fired_rule": "fleet_capacity_degraded",
+            "bundle": {
+                "dir": os.path.basename(bundle_dir),
+                "files": manifest["files"],
+                "schema": manifest["schema"],
+            },
+            "resolved_after_respawn": True,
+        }
 
         # --- scenario: rolling_deploy -------------------------------------
         orbax_io.save_model(ckpt, p_v2)  # publishes as version 2
@@ -665,6 +766,21 @@ def run_fleet_drill(args) -> int:
                 f"fleet metrics written to {args.fleet_metrics_out}",
                 file=sys.stderr,
             )
+        for family in ("alerts_active", "alerts_transitions_total",
+                       "incident_captures_total", "history_samples_total"):
+            assert family in page, f"{family} missing from router /metrics"
+
+        # The history plane itself, over the live HTTP surface: the
+        # drill's whole timeline should be sitting in the ring.
+        with urllib.request.urlopen(
+            base + "/debug/history?family=fleet_replicas&window=600",
+            timeout=HARD_TIMEOUT_S,
+        ) as resp:
+            history = json.loads(resp.read())
+        assert history["series"] and all(
+            s["points"] for s in history["series"]
+        ), "no fleet_replicas history despite a running sampler"
+
         fleet_telemetry = {
             "trace": {
                 "requests": trace_other["requests"],
@@ -675,6 +791,18 @@ def run_fleet_drill(args) -> int:
             },
             "fleet_metrics_validated": True,
         }
+        alerting["final"] = router.alerts.summary()
+        alerting["history_series"] = len(history["series"])
+        if args.incident_out:
+            import shutil
+
+            dst = os.path.join(
+                args.incident_out, os.path.basename(bundle_dir)
+            )
+            if os.path.isdir(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(bundle_dir, dst)
+            print(f"incident bundle copied to {dst}", file=sys.stderr)
     finally:
         if traffic is not None:
             traffic.stop()
@@ -697,7 +825,8 @@ def run_fleet_drill(args) -> int:
     kinds = {e.get("kind") for e in events}
     for needed in ("fleet_router_started", "fleet_replica_registered",
                    "fleet_rotation", "fleet_deploy_start",
-                   "fleet_deploy_replica", "fleet_deploy_done"):
+                   "fleet_deploy_replica", "fleet_deploy_done",
+                   "alert_fired", "alert_resolved", "incident_captured"):
         assert needed in kinds, f"router journal lacks {needed!r}"
     replica_kinds = set()
     for path in list(replica_journals.values()) + [
@@ -733,6 +862,7 @@ def run_fleet_drill(args) -> int:
         "traffic_total": overall,
         "scenarios": scenarios,
         "fleet_telemetry": fleet_telemetry,
+        "alerting": alerting,
         "router_journal_kinds": sorted(k for k in kinds if k),
         "replica_journal_kinds": sorted(
             k for k in replica_kinds if k
@@ -1120,6 +1250,19 @@ def main(argv=None) -> int:
         help="(--fleet) write the cross-process joined /fleet/trace "
         "export (Perfetto-loadable) captured during the healthy "
         "two-replica window here",
+    )
+    ap.add_argument(
+        "--incident-out", default=None,
+        help="(--fleet) copy the incident bundle captured during the "
+        "kill-replica scenario (alert + history window + request tail "
+        "+ journal tail, manifest-complete) to this directory",
+    )
+    ap.add_argument(
+        "--metrics-early-out", default=None,
+        help="(--fleet) write the router's /metrics page scraped during "
+        "the healthy baseline window here — pairs with --metrics-out "
+        "for a tools/validate_metrics.py --diff monotonicity check "
+        "across the drill",
     )
     ap.add_argument(
         "--surge", action="store_true",

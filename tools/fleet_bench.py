@@ -162,6 +162,11 @@ def main(argv=None) -> int:
     ap.add_argument("--assert-overhead-ms", type=float, default=None,
                     help="fail unless router-added p50 <= this")
     ap.add_argument("--ready-timeout", type=float, default=300.0)
+    ap.add_argument("--history-interval", type=float, default=10.0,
+                    help="router history-sampler interval for the "
+                    "in-process router (0 disables the history/alerting "
+                    "plane — the no-history leg of the sampler-overhead "
+                    "comparison)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     if getattr(args, "_stub_worker", None):
@@ -220,6 +225,7 @@ def main(argv=None) -> int:
             port=0, probe_interval_s=0.5,
             request_timeout_s=args.request_timeout,
             hedge_ms=args.hedge_ms, max_attempts=3,
+            history_interval_s=args.history_interval,
         ).start_background()
         base = f"http://{router.address[0]}:{router.address[1]}"
         for rid, url in stub_members:

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Render an incident flight-recorder bundle for humans.
+
+``obs.incident`` captures machine-readable JSON the moment a rule
+fires; this tool turns one bundle (or the newest bundle in a directory
+of them) into the markdown summary an on-call human actually reads:
+what fired, what the signal looked like around onset, what the fleet
+was doing, and the journal context leading up to it.
+
+Usage:
+    python tools/incident_report.py INCIDENT_DIR          # one bundle
+    python tools/incident_report.py --latest BUNDLES_DIR  # newest
+    python tools/incident_report.py INCIDENT_DIR --out report.md
+
+A directory without a ``manifest.json`` is an *incomplete* capture
+(crash mid-write) and is refused — the manifest is the completeness
+marker, not decoration. Exit 0 on success, 2 on a missing/incomplete
+bundle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+MANIFEST = "manifest.json"
+
+
+def _load(bundle: str, name: str):
+    path = os.path.join(bundle, name)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _latest_bundle(parent: str) -> str | None:
+    best = None
+    for n in sorted(os.listdir(parent)):
+        d = os.path.join(parent, n)
+        if n.startswith("incident_") and \
+                os.path.exists(os.path.join(d, MANIFEST)):
+            best = d  # names carry a UTC stamp: sorted == chronological
+    return best
+
+
+def _fmt_series_tail(series: list, limit: int = 6) -> list[str]:
+    lines = []
+    for s in series:
+        pts = s.get("points", [])[-limit:]
+        lab = s.get("labels") or {}
+        lab_s = ",".join(f"{k}={v}" for k, v in sorted(lab.items()))
+        vals = " ".join(
+            f"{p[1]:.4g}" if len(p) == 2 else f"n={p[1]:.0f}"
+            for p in pts
+        )
+        lines.append(f"  - `{{{lab_s}}}`: {vals}")
+    return lines
+
+
+def render(bundle: str) -> str:
+    manifest = _load(bundle, MANIFEST)
+    if manifest is None:
+        raise FileNotFoundError(
+            f"{bundle}: no {MANIFEST} — incomplete capture (a crashed "
+            "capture never writes its manifest)"
+        )
+    alert = _load(bundle, "alert.json") or {}
+    history = _load(bundle, "history.json") or {}
+    out = []
+    out.append(f"# Incident: {manifest.get('rule')} "
+               f"({manifest.get('severity')})")
+    out.append("")
+    out.append(f"- bundle: `{os.path.basename(bundle)}`")
+    out.append(f"- captured: {manifest.get('captured_at')}")
+    out.append(f"- schema: {manifest.get('schema')}, files: "
+               f"{len(manifest.get('files', []))}, history window: "
+               f"{manifest.get('window_s')}s")
+    if manifest.get("errors"):
+        out.append(f"- collector errors: {manifest['errors']}")
+    out.append("")
+    out.append("## Triggering rule")
+    out.append("")
+    out.append(f"- detail: {alert.get('detail')}")
+    out.append(f"- value: {alert.get('value')}")
+    spec = alert.get("spec") or {}
+    if spec:
+        out.append(f"- spec: `{json.dumps(spec, sort_keys=True)}`")
+    out.append("")
+
+    fam = spec.get("family")
+    if fam and fam in history:
+        out.append(f"## Signal around onset: `{fam}`")
+        out.append("")
+        out.extend(_fmt_series_tail(history[fam].get("series", [])))
+        out.append("")
+
+    reqs = _load(bundle, "requests.json")
+    if isinstance(reqs, list) and reqs:
+        out.append(f"## Request tail ({len(reqs)} sampled)")
+        out.append("")
+        def total(r):
+            return r.get("total_s") or 0.0
+        slow = sorted(reqs, key=total, reverse=True)[:5]
+        for r in slow:
+            out.append(
+                f"  - `{r.get('request_id', '?')}` "
+                f"{1000.0 * total(r):.1f} ms "
+                f"status={r.get('status', r.get('outcome', '?'))}"
+            )
+        out.append("")
+
+    replicas = _load(bundle, "replicas.json")
+    if isinstance(replicas, list):
+        out.append(f"## Replicas ({len(replicas)})")
+        out.append("")
+        for rep in replicas:
+            out.append(
+                f"  - `{rep.get('id')}` state={rep.get('state')} "
+                f"in_rotation={rep.get('in_rotation')} "
+                f"url={rep.get('url')}"
+            )
+        out.append("")
+
+    trace = _load(bundle, "fleet_trace.json")
+    if isinstance(trace, dict):
+        n_ev = len(trace.get("traceEvents", []))
+        meta = trace.get("otherData", {})
+        out.append(f"## Fleet trace join: {n_ev} events, "
+                   f"otherData={json.dumps(meta, sort_keys=True)}")
+        out.append("")
+
+    tail_path = os.path.join(bundle, "journal_tail.jsonl")
+    if os.path.exists(tail_path):
+        interesting = []
+        with open(tail_path, encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                kind = rec.get("kind", "")
+                if kind.startswith(("alert_", "fleet_", "lifecycle_",
+                                    "incident_", "autoscale_")):
+                    interesting.append(rec)
+        out.append(f"## Journal context ({len(interesting)} "
+                   "fleet/alert events in tail)")
+        out.append("")
+        for rec in interesting[-15:]:
+            slim = {k: v for k, v in rec.items() if k != "ts"}
+            out.append(f"  - {rec.get('ts')} `{json.dumps(slim)}`")
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    out_path = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        out_path = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    latest = "--latest" in argv
+    if latest:
+        argv.remove("--latest")
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    bundle = argv[0]
+    if latest:
+        found = _latest_bundle(bundle)
+        if found is None:
+            print(f"{bundle}: no complete incident bundles",
+                  file=sys.stderr)
+            return 2
+        bundle = found
+    try:
+        text = render(bundle)
+    except FileNotFoundError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {out_path}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1266,7 +1266,22 @@ def main(argv=None) -> int:
         help="retry backoff cap",
     )
     ap.add_argument("--out", default=None, help="artifact path (JSON)")
+    ap.add_argument(
+        "--assert-slo", default=None, metavar="P50:MS,P99:MS,ERR:FRAC",
+        help="exit 3 when the artifact violates the stated budget: "
+        "comma-separated KEY:BOUND pairs where KEY is a latency "
+        "percentile (p50/p95/p99/mean/max, bound in ms, over ok "
+        "replies) or 'err' (bound on (n_err+n_shed)/n_sent). Drill and "
+        "bench jobs gate on client-observed SLO with this instead of "
+        "eyeballing JSON",
+    )
     args = ap.parse_args(argv)
+    slo_budget = None
+    if args.assert_slo:
+        try:
+            slo_budget = _parse_slo_budget(args.assert_slo)
+        except ValueError as exc:
+            ap.error(str(exc))
     if args.patient and args.patients:
         ap.error("--patient and --patients are mutually exclusive")
     if not 0.0 <= args.perturb_at <= 1.0:
@@ -1478,7 +1493,85 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             f.write(line + "\n")
         print(f"artifact written to {args.out}", file=sys.stderr)
+    if slo_budget is not None:
+        violations = _check_slo_budget(artifact, slo_budget)
+        if violations:
+            for v in violations:
+                print(f"SLO VIOLATION: {v}", file=sys.stderr)
+            return 3
+        print(
+            "SLO OK: " + ", ".join(
+                f"{k}<={b:g}" for k, b in sorted(slo_budget.items())
+            ),
+            file=sys.stderr,
+        )
     return 0
+
+
+def _parse_slo_budget(spec: str) -> dict[str, float]:
+    """``P50:MS,P99:MS,ERR:FRAC`` → ``{"p50": ms, "p99": ms, "err":
+    frac}``. Keys are case-insensitive; any subset of
+    p50/p95/p99/mean/max/err is allowed."""
+    budget: dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"--assert-slo: {part!r} is not KEY:BOUND"
+            )
+        key, _, bound = part.partition(":")
+        key = key.strip().lower()
+        if key not in ("p50", "p95", "p99", "mean", "max", "err"):
+            raise ValueError(
+                f"--assert-slo: unknown key {key!r} (know "
+                "p50/p95/p99/mean/max/err)"
+            )
+        if key in budget:
+            raise ValueError(f"--assert-slo: duplicate key {key!r}")
+        try:
+            budget[key] = float(bound)
+        except ValueError:
+            raise ValueError(
+                f"--assert-slo: bound {bound!r} is not a number"
+            ) from None
+        if budget[key] < 0:
+            raise ValueError(f"--assert-slo: {key} bound must be >= 0")
+    if not budget:
+        raise ValueError("--assert-slo: empty budget")
+    return budget
+
+
+def _check_slo_budget(artifact: dict, budget: dict) -> list[str]:
+    """The violations (empty = within budget). A latency percentile
+    that is null (zero ok replies) violates any latency bound — a run
+    that completed nothing did not meet its SLO."""
+    violations = []
+    latency = artifact.get("latency_ms") or {}
+    for key, bound in sorted(budget.items()):
+        if key == "err":
+            n_sent = artifact.get("n_sent") or 0
+            bad = (artifact.get("n_err") or 0) + \
+                (artifact.get("n_shed") or 0)
+            frac = bad / n_sent if n_sent else 1.0
+            if frac > bound:
+                violations.append(
+                    f"err rate {frac:.4f} > budget {bound:g} "
+                    f"({bad}/{n_sent} shed+err)"
+                )
+            continue
+        got = latency.get(key)
+        if got is None:
+            violations.append(
+                f"{key} latency unavailable (no ok replies) — budget "
+                f"{bound:g} ms unmet"
+            )
+        elif got > bound:
+            violations.append(
+                f"{key} latency {got:.3f} ms > budget {bound:g} ms"
+            )
+    return violations
 
 
 if __name__ == "__main__":

@@ -32,9 +32,23 @@ Checks (text format 0.0.4, plus the grouping rule scrapers enforce):
 Usage:
     python tools/validate_metrics.py [file ...]      # or stdin
     curl -s localhost:8000/metrics | python tools/validate_metrics.py
+    python tools/validate_metrics.py --diff A B      # scrape pair
+
+``--diff A B`` compares two exposition snapshots of the SAME process
+family-by-family and flags counter regressions: every counter sample
+(and every histogram ``_bucket``/``_count``/``_sum`` — cumulative too)
+present in both pages must be monotonically non-decreasing from A to B.
+A series present only in one page is fine (new work started; a retired
+replica's series was dropped) — only a value that went *backwards*
+without the process restarting is a lie, and it is exactly the lie that
+poisons every rate derivation downstream (the history store's
+reset-safe ``rate()`` would silently eat the decrease). Both pages are
+also strict-validated first. CI runs this across two scrapes of the
+chaos drill's router.
 
 Exit 0 when every input page is valid; 1 otherwise, one error per line on
-stderr. Importable: ``validate(text) -> list[str]`` returns the errors.
+stderr. Importable: ``validate(text) -> list[str]`` returns the errors,
+``diff_counters(a, b) -> list[str]`` the regressions.
 """
 
 from __future__ import annotations
@@ -317,8 +331,94 @@ def validate(text: str) -> list[str]:
     return errors
 
 
+def _monotone_samples(text: str) -> dict[tuple, float]:
+    """``{(sample_name, ((label, value), ...)): value}`` for every
+    sample with counter semantics: TYPE counter families, plus the
+    ``_bucket``/``_count``/``_sum`` samples of TYPE histogram families
+    (all cumulative; ``_sum`` is monotone because every histogram here
+    observes non-negative quantities)."""
+    typed: dict[str, str] = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                typed[parts[2]] = parts[3].strip()
+    out: dict[tuple, float] = {}
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name = m.group("name")
+        base = _base_family(name, typed)
+        kind = typed.get(base)
+        monotone = kind == "counter" or (
+            kind == "histogram" and name != base
+        )
+        if not monotone:
+            continue
+        value = _parse_value(m.group("value"))
+        if value is None or math.isnan(value):
+            continue
+        labels = _parse_labels(m.group("labels") or "", "", []) or {}
+        out[(name, tuple(sorted(labels.items())))] = value
+    return out
+
+
+def diff_counters(a_text: str, b_text: str) -> list[str]:
+    """Counter-monotonicity regressions from snapshot A to snapshot B
+    (A taken first). Empty list = every shared cumulative series is
+    non-decreasing."""
+    a, b = _monotone_samples(a_text), _monotone_samples(b_text)
+    errors = []
+    for key in sorted(set(a) & set(b)):
+        if b[key] < a[key]:
+            name, labels = key
+            lab = "{%s}" % ",".join(
+                f'{k}="{v}"' for k, v in labels
+            ) if labels else ""
+            errors.append(
+                f"counter regression: {name}{lab} went "
+                f"{a[key]:g} -> {b[key]:g}"
+            )
+    return errors
+
+
+def _main_diff(path_a: str, path_b: str) -> int:
+    with open(path_a) as fh:
+        a_text = fh.read()
+    with open(path_b) as fh:
+        b_text = fh.read()
+    rc = 0
+    for src, text in ((path_a, a_text), (path_b, b_text)):
+        for e in validate(text):
+            rc = 1
+            print(f"{src}: {e}", file=sys.stderr)
+    errs = diff_counters(a_text, b_text)
+    for e in errs:
+        rc = 1
+        print(f"{path_a} -> {path_b}: {e}", file=sys.stderr)
+    if rc == 0:
+        shared = len(
+            set(_monotone_samples(a_text)) & set(_monotone_samples(b_text))
+        )
+        print(
+            f"{path_a} -> {path_b}: diff OK ({shared} cumulative "
+            "series monotone)",
+            file=sys.stderr,
+        )
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--diff":
+        if len(argv) != 3:
+            print("usage: validate_metrics.py --diff A B",
+                  file=sys.stderr)
+            return 2
+        return _main_diff(argv[1], argv[2])
     pages: list[tuple[str, str]] = []
     if argv:
         for path in argv:
